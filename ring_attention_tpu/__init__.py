@@ -1,0 +1,21 @@
+"""ring-attention-tpu: a TPU-native long-context attention framework.
+
+Built from scratch in JAX/XLA with the capabilities of
+lucidrains/ring-attention-pytorch: ring attention (sequence-parallel exact
+attention over a device mesh via shard_map + ppermute), striped ring
+attention for causal load balance, grouped-query attention, per-layer
+lookback windows, shard-aware rotary embeddings, and RingAttention /
+RingTransformer model layers.
+"""
+
+__version__ = "0.1.0"
+
+from .ops import (
+    default_attention,
+    flash_attention,
+)
+
+__all__ = [
+    "default_attention",
+    "flash_attention",
+]

@@ -1,0 +1,5 @@
+from .attention import RingAttention
+from .layers import FeedForward, RMSNorm
+from .transformer import RingTransformer
+
+__all__ = ["RingAttention", "FeedForward", "RMSNorm", "RingTransformer"]

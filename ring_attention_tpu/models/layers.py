@@ -1,0 +1,39 @@
+"""Transformer building blocks: RMSNorm and FeedForward.
+
+TPU-native equivalents of the reference's ``RMSNorm``
+(ref ``ring_attention.py:470-477``: ``F.normalize(x) * sqrt(dim) * gamma``)
+and ``FeedForward`` (ref ``ring_attention.py:479-486``: prenorm -> Dense(mult*dim)
+-> GELU -> Dense(dim)).  Norm statistics are computed in float32 regardless
+of activation dtype, then cast back — the standard TPU mixed-precision
+recipe.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class RMSNorm(nn.Module):
+    dim: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        gamma = self.param("gamma", nn.initializers.ones, (self.dim,))
+        xf = x.astype(jnp.float32)
+        rms = jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-12)
+        return ((xf / rms) * gamma).astype(x.dtype)
+
+
+class FeedForward(nn.Module):
+    dim: int
+    mult: int = 4
+    dtype: jnp.dtype | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = RMSNorm(self.dim)(x)
+        h = nn.Dense(self.dim * self.mult, use_bias=False, dtype=self.dtype)(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(h)

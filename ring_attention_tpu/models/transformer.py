@@ -1,0 +1,145 @@
+"""RingTransformer: end-to-end causal LM over a sharded sequence.
+
+TPU-native equivalent of the reference's ``RingTransformer``
+(ref ``ring_attention.py:488-685``): token embedding, depth x
+(RingAttention + FeedForward) residual blocks, final RMSNorm + logits, and
+autoregressive cross-entropy with label auto-shift and pad-label masking
+(ref ``ring_attention.py:599-615``).
+
+Sharding is decided once at the model top (pad -> stripe -> sharding
+constraint) and the attention layers run pre-sharded (the reference
+similarly passes ``auto_shard_seq=False`` down to layers,
+ref ``ring_attention.py:565``).  Per-layer ``max_lookback_seq_len`` gives
+local -> global attention over depth (ref ``ring_attention.py:546-561``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
+from ..parallel.sharding import pad_to_multiple, stripe_permute, stripe_unpermute
+from .attention import RingAttention
+from .layers import FeedForward, RMSNorm
+
+
+class RingTransformer(nn.Module):
+    num_tokens: int
+    dim: int
+    depth: int
+    causal: bool = False
+    heads: int = 8
+    dim_head: int = 64
+    kv_heads: int | None = None
+    bucket_size: int = 512
+    striped: bool = False
+    use_ring: bool = True
+    force_regular_attn: bool = False
+    rotary: bool = True
+    softclamp_value: float | None = None
+    # int -> same lookback every layer; tuple -> per layer (None = global)
+    max_lookback_seq_len: int | tuple[int | None, ...] | None = None
+    ff_mult: int = 4
+    ignore_index: int = -1
+    auto_shard: bool = True
+    mesh: Mesh | None = None
+    dtype: jnp.dtype | None = None
+
+    def _ring_size(self) -> int:
+        if self.mesh is None or not self.use_ring or self.force_regular_attn:
+            return 1
+        return self.mesh.shape[SEQ_AXIS]
+
+    def _lookbacks(self) -> tuple[int | None, ...]:
+        lb = self.max_lookback_seq_len
+        if not isinstance(lb, tuple):
+            lb = (lb,) * self.depth
+        assert len(lb) == self.depth
+        return lb
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        mask: jax.Array | None = None,
+        return_loss: bool = False,
+    ) -> jax.Array:
+        """``tokens: (b, n)`` int32 -> logits ``(b, n, num_tokens)`` or scalar loss."""
+        if return_loss:
+            labels = tokens[:, 1:]
+            tokens = tokens[:, :-1]
+
+        ring = self._ring_size()
+        n_orig = tokens.shape[1]
+        striped = self.striped and ring > 1
+
+        if ring > 1 and self.auto_shard:
+            tokens, _ = pad_to_multiple(tokens, ring)
+            padded = tokens.shape[1] != n_orig
+            if padded and mask is None and not self.causal:
+                # non-causal: real tokens must not attend to the pad slots,
+                # so synthesize a key-padding mask (ref ring_attention.py:211-219);
+                # causal needs none — pad sits after every real query and the
+                # padded output rows are sliced off below.
+                mask = jnp.arange(tokens.shape[1])[None, :] < n_orig
+                mask = jnp.broadcast_to(mask, tokens.shape)
+            if striped:
+                tokens = stripe_permute(tokens, ring)
+            tokens = lax.with_sharding_constraint(
+                tokens, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS))
+            )
+            if mask is not None:
+                mask, _ = pad_to_multiple(mask, ring, value=False)
+                if striped:
+                    mask = stripe_permute(mask, ring)
+
+        x = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype)(tokens)
+        if ring > 1 and self.auto_shard:
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
+            )
+
+        for lookback in self._lookbacks():
+            x = (
+                RingAttention(
+                    dim=self.dim,
+                    heads=self.heads,
+                    dim_head=self.dim_head,
+                    kv_heads=self.kv_heads,
+                    causal=self.causal,
+                    striped=striped,
+                    bucket_size=self.bucket_size,
+                    use_ring=self.use_ring,
+                    force_regular_attn=self.force_regular_attn,
+                    rotary=self.rotary,
+                    softclamp_value=self.softclamp_value,
+                    max_lookback_seq_len=lookback,
+                    auto_shard=False,  # sharded once at model top
+                    mesh=self.mesh,
+                    dtype=self.dtype,
+                )(x, mask)
+                + x
+            )
+            x = FeedForward(self.dim, self.ff_mult, dtype=self.dtype)(x) + x
+
+        x = RMSNorm(self.dim)(x)
+        logits = nn.Dense(self.num_tokens, use_bias=False, dtype=self.dtype)(x)
+
+        if ring > 1 and self.auto_shard:
+            if striped:
+                logits = stripe_unpermute(logits, ring)
+            logits = logits[:, :n_orig]
+
+        if not return_loss:
+            return logits
+
+        # Cross-entropy with ignore_index (ref ring_attention.py:664-673)
+        valid = labels != self.ignore_index
+        safe_labels = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)

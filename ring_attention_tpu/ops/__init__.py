@@ -1,0 +1,27 @@
+from .attention import default_attention, softclamp, MASK_VALUE, EPSILON
+from .flash import (
+    FlashCarry,
+    attend_blocks,
+    finalize,
+    flash_attention,
+    flash_backward_blocks,
+    init_carry,
+)
+from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
+
+__all__ = [
+    "default_attention",
+    "softclamp",
+    "MASK_VALUE",
+    "EPSILON",
+    "FlashCarry",
+    "attend_blocks",
+    "finalize",
+    "flash_attention",
+    "flash_backward_blocks",
+    "init_carry",
+    "apply_rotary",
+    "ring_positions",
+    "rotary_freqs",
+    "rotate_half",
+]

@@ -1,0 +1,84 @@
+"""Reference (oracle) dense attention in pure JAX.
+
+TPU-native analogue of the reference's ``default_attention``
+(``ring_attention.py:47-98`` in lucidrains/ring-attention-pytorch): an exact,
+materialize-the-scores attention used as the ground truth for every parity
+test, and as the ``force_regular_attn`` fallback in the model layer.
+
+Capabilities (matching the reference oracle):
+  - grouped-query attention: ``q`` has ``h = hk * g`` heads attending against
+    ``hk`` key/value heads (ref ``ring_attention.py:64-68``)
+  - logit soft-clamping ``c * tanh(s / c)`` (ref ``ring_attention.py:44-45,76-77``)
+  - causal masking, or key-padding masking (mutually exclusive in the
+    reference as well, ref ``ring_attention.py:81-88``)
+
+Layout convention for all ops in this package: ``q: (b, h, n, d)``,
+``k, v: (b, hk, n, d)`` — heads-major so the attention matmuls present
+``(n, d) x (d, n)`` contractions that tile directly onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Large-but-finite mask value: avoids the NaNs that -inf produces for
+# fully-masked rows (exp(-inf - -inf)).  The reference uses
+# ``-torch.finfo(dtype).max`` the same way.
+MASK_VALUE = -0.5 * float(jnp.finfo(jnp.float32).max)
+EPSILON = 1e-10  # ref ring_attention_pytorch/ring_flash_attention.py:25
+
+
+def softclamp(x: jax.Array, value: float) -> jax.Array:
+    """Soft clamp logits to (-value, value) via tanh (Gemma-style capping)."""
+    return jnp.tanh(x / value) * value
+
+
+@partial(jax.jit, static_argnames=("causal", "softclamp_value"))
+def default_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    *,
+    causal: bool = False,
+    softclamp_value: float | None = None,
+) -> jax.Array:
+    """Exact dense attention oracle.
+
+    Args:
+      q: ``(b, h, nq, d)`` queries.
+      k: ``(b, hk, nk, d)`` keys; ``h`` must be a multiple of ``hk`` (GQA).
+      v: ``(b, hk, nk, d)`` values.
+      mask: optional ``(b, nk)`` boolean key-padding mask, True = attend.
+      causal: apply a causal mask (ignores ``mask`` if set, as the reference
+        asserts the two are exclusive).
+      softclamp_value: if set, logits are soft-clamped to this magnitude.
+
+    Returns:
+      ``(b, h, nq, d)`` attention output in ``q.dtype``.
+    """
+    b, h, nq, d = q.shape
+    _, hk, nk, _ = k.shape
+    assert h % hk == 0, "query heads must be a multiple of kv heads"
+    g = h // hk
+
+    scale = d**-0.5
+    qg = q.reshape(b, hk, g, nq, d).astype(jnp.float32)
+    sim = jnp.einsum("bhgid,bhjd->bhgij", qg, k.astype(jnp.float32)) * scale
+
+    if softclamp_value is not None:
+        sim = softclamp(sim, softclamp_value)
+
+    if causal:
+        i = jnp.arange(nq)[:, None]
+        j = jnp.arange(nk)[None, :]
+        sim = jnp.where(j <= i + (nk - nq), sim, MASK_VALUE)
+    elif mask is not None:
+        sim = jnp.where(mask[:, None, None, None, :], sim, MASK_VALUE)
+
+    attn = jax.nn.softmax(sim, axis=-1)
+    out = jnp.einsum("bhgij,bhjd->bhgid", attn, v.astype(jnp.float32))
+    return out.reshape(b, h, nq, d).astype(q.dtype)

@@ -1,0 +1,55 @@
+"""Shard-aware rotary position embeddings.
+
+TPU-native analogue of ``RingRotaryEmbedding`` / ``apply_rotary_pos_emb``
+(ref ``ring_attention.py:102-172``).  The reference's key subtlety is that
+positions must reflect how the sequence was sharded:
+
+  - plain ring sharding: rank ``r`` holds the contiguous slice
+    ``[r * n_local, (r + 1) * n_local)`` (ref ``ring_attention.py:153-155``)
+  - striped sharding: rank ``r`` holds every ``world``-th token starting at
+    ``r``, i.e. global position of local index ``i`` is ``i * world + r``
+    (ref ``ring_attention.py:142-151``; we stripe at token granularity, the
+    reference's ``buckets=1`` fused-kernel case)
+
+Here those are pure position computations: the model computes per-shard
+positions (optionally inside ``shard_map`` using ``lax.axis_index``) and
+feeds them to ``rotary_freqs`` -> ``apply_rotary``.  Rotary math is always
+float32 (the reference forces fp32 via autocast-off, ref
+``ring_attention.py:128,167``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_positions(n_local: int, rank: jax.Array | int, *, striped: bool, world: int) -> jax.Array:
+    """Global token positions for one sequence shard.
+
+    ``rank`` may be a traced scalar (e.g. ``lax.axis_index``) so the same
+    compiled program serves every mesh position.
+    """
+    i = jnp.arange(n_local)
+    if striped:
+        return i * world + rank
+    return i + rank * n_local
+
+
+def rotary_freqs(positions: jax.Array, dim: int, theta: float = 10000.0) -> jax.Array:
+    """Angles ``(n, dim)`` for NeoX-style (half-rotation) rotary embedding."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.concatenate([freqs, freqs], axis=-1)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(x: jax.Array, freqs: jax.Array) -> jax.Array:
+    """Apply rotary embedding.  ``x: (..., n, d)``, ``freqs: (n, d)``."""
+    xf = x.astype(jnp.float32)
+    out = xf * jnp.cos(freqs) + rotate_half(xf) * jnp.sin(freqs)
+    return out.astype(x.dtype)

@@ -1,0 +1,21 @@
+from .mesh import DATA_AXIS, SEQ_AXIS, create_mesh, replicated, seq_sharding
+from .ring import ring_flash_attention
+from .sharding import (
+    pad_seq_and_mask,
+    pad_to_multiple,
+    stripe_permute,
+    stripe_unpermute,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "SEQ_AXIS",
+    "create_mesh",
+    "replicated",
+    "seq_sharding",
+    "ring_flash_attention",
+    "pad_seq_and_mask",
+    "pad_to_multiple",
+    "stripe_permute",
+    "stripe_unpermute",
+]

@@ -1,0 +1,53 @@
+"""Mesh construction and axis-name conventions.
+
+The reference expresses topology as rank arithmetic over one flat world,
+with "ring sets" carving the world into independent rings for hybrid
+data-parallel x sequence-parallel runs (ref ``ring.py:35-47``,
+``ring_attention.py:636-638``).  The TPU-native expression is a 2-D
+``jax.sharding.Mesh`` with axes ``(data, seq)``: each row of the mesh is one
+ring, ppermute over ``seq`` is automatically scoped per row, and gradient
+psum over ``data`` is the DDP analogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def create_mesh(
+    ring_size: int | None = None,
+    data_size: int | None = None,
+    *,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(data, seq)`` mesh.
+
+    ``ring_size`` defaults to all devices (one big ring); ``data_size``
+    defaults to ``n_devices // ring_size`` — the reference's
+    ``num_sharded_batches`` derivation (ref ``ring_attention.py:636-638``).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if ring_size is None:
+        ring_size = n if data_size is None else n // data_size
+    if data_size is None:
+        data_size = n // ring_size
+    assert data_size * ring_size == n, (
+        f"mesh {data_size}x{ring_size} != {n} devices"
+    )
+    arr = np.asarray(devices).reshape(data_size, ring_size)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+def seq_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``(b, n, ...)`` activations: batch over data, seq over ring."""
+    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,288 @@
+"""Ring attention over a TPU mesh axis: `lax.ppermute` + online softmax.
+
+TPU-native redesign of the reference's L1+L3 (``ring.py`` /
+``ring_flash_attention.py`` in lucidrains/ring-attention-pytorch).  The
+reference hand-rolls a P2P ring (batched isend/irecv + barrier per hop,
+``ring.py:51-60``) and a hand-written autograd Function
+(``ring_flash_attention.py:60-387``).  Here the entire communication layer is
+one collective — ``lax.ppermute`` over a named mesh axis inside ``shard_map``
+— which XLA pipelines with the per-hop flash compute (the overlap the
+reference explicitly lacks), and differentiation is a ``jax.custom_vjp``
+whose backward rotates ``(k, v, dk, dv)`` together, finishing with the
+catch-up rotation that returns partial dk/dv to their owner shard when
+``max_ring_passes`` limits the loop (ref ``ring_flash_attention.py:380-385``).
+
+Ring-set math (multiple independent rings inside one world,
+ref ``ring.py:35-47``) needs no code at all: ppermute over the ``seq`` mesh
+axis is automatically scoped per row of the ``(data, seq)`` mesh.
+
+Masking unification (see ``ops/flash.py``): each hop computes a single
+*causal offset* scalar from ``(my_rank, origin_rank)``:
+
+  - plain causal:   ``offset = (rank - origin) * n_local``  — covers
+    "skip hop entirely" (origin > rank), "triangular" (origin == rank) and
+    "fully visible" (origin < rank) in one expression
+    (ref ``ring_flash_attention.py:177-192``).
+  - striped causal: ``offset = 0 if origin <= rank else -1`` — the
+    inclusive/exclusive diagonal flip (ref ``triton_flash_attn.py:216-221``,
+    ``ring_flash_attention_cuda.py:158-160``).
+
+Hops that provably contribute nothing (plain causal, origin ahead of rank;
+or beyond the lookback window) skip their compute through ``lax.cond`` —
+the per-device branch is resolved at run time from ``axis_index``, while the
+ppermute stays outside the cond so the collective schedule is identical on
+every device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.flash import (
+    FlashCarry,
+    attend_blocks,
+    finalize,
+    flash_backward_blocks,
+    init_carry,
+    match_vma,
+    _group_q,
+    _ungroup,
+)
+
+
+def _ring_perm(axis_name: str) -> list[tuple[int, int]]:
+    # psum of ones is the SPMD-safe way to get the axis size as a python int
+    # at trace time; axis sizes are always static in shard_map.
+    size = lax.axis_size(axis_name)
+    return [(j, (j + 1) % size) for j in range(size)]
+
+
+def _rotate(x, axis_name: str):
+    return lax.ppermute(x, axis_name, _ring_perm(axis_name))
+
+
+def _hop_offset(
+    rank: jax.Array,
+    origin: jax.Array,
+    n_local: int,
+    causal: bool,
+    striped: bool,
+) -> jax.Array | None:
+    """Banded-causal offset for the tile (my queries) x (origin's keys)."""
+    if not causal:
+        return None
+    if striped:
+        return jnp.where(origin <= rank, 0, -1)
+    return (rank - origin) * n_local
+
+
+def _hop_has_work(
+    offset: jax.Array | None, n_local: int, window: int | None
+) -> jax.Array:
+    if offset is None:
+        return jnp.bool_(True)
+    lo = offset >= -(n_local - 1)
+    if window is not None:
+        return lo & (offset - (window - 1) <= n_local - 1)
+    return lo
+
+
+@partial(
+    jax.custom_vjp,
+    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11),
+)
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None,
+    axis_name: str,
+    causal: bool = False,
+    striped: bool = False,
+    bucket_size: int | None = None,
+    max_ring_passes: int | None = None,
+    window: int | None = None,
+    softclamp_value: float | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Sequence-parallel exact attention; call inside ``shard_map``.
+
+    Args:
+      q: ``(b, h, n_local, d)`` local query shard.
+      k, v: ``(b, hk, n_local, d)`` local key/value shards (GQA when hk < h —
+        the ring then only moves hk-sized blocks, the reference's
+        bandwidth-saving trick, ref ``ring_attention.py:317-321``).
+      kv_mask: optional ``(b, n_local)`` key-padding mask shard; rotates
+        around the ring with k/v.
+      axis_name: mesh axis the sequence is sharded over.
+      causal/striped: causal masking, with striped (balanced) layout if the
+        sequence was stripe-permuted before sharding.
+      bucket_size: flash tile size within a hop.
+      max_ring_passes: limit hops for per-layer lookback windows
+        (ref ``ring_flash_attention.py:95-103``).
+      window: exact sliding-window lookback in tokens (non-striped only).
+
+    Returns:
+      ``(b, h, n_local, d)`` output shard, in ``q.dtype``.
+    """
+    out, _ = _ring_fwd_impl(
+        q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+        max_ring_passes, window, softclamp_value, scale,
+    )
+    return out
+
+
+def _ring_fwd_impl(
+    q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+    max_ring_passes, window, softclamp_value, scale,
+):
+    b, h, n_local, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    if scale is None:
+        scale = d**-0.5
+    ring_size = lax.axis_size(axis_name)
+    passes = min(max_ring_passes or ring_size, ring_size)
+    rank = lax.axis_index(axis_name)
+
+    carry = init_carry(b, hk, g, n_local, d, like=q)
+    kv = jnp.stack([k, v])  # one message per hop, ref ring_flash_attention.py:129
+    mask_carry = kv_mask
+
+    def hop(i, flash, kv, mask_carry):
+        origin = (rank - i) % ring_size
+        offset = _hop_offset(rank, origin, n_local, causal, striped)
+        has_work = _hop_has_work(offset, n_local, window)
+
+        def do_attend(flash):
+            return attend_blocks(
+                q, kv[0], kv[1], flash,
+                scale=scale, bucket_size=bucket_size, causal_offset=offset,
+                window=window, kv_mask=mask_carry,
+                softclamp_value=softclamp_value,
+            )
+
+        flash = lax.cond(has_work, do_attend, lambda f: f, flash)
+        # rotate AFTER compute; collective outside the cond so the schedule
+        # is uniform across devices
+        kv = _rotate(kv, axis_name)
+        if mask_carry is not None:
+            mask_carry = _rotate(mask_carry, axis_name)
+        return flash, kv, mask_carry
+
+    if mask_carry is None:
+        def body(c, i):
+            flash, kv = c
+            flash, kv, _ = hop(i, flash, kv, None)
+            return (flash, kv), None
+
+        (carry, _), _ = lax.scan(body, (carry, kv), jnp.arange(passes))
+    else:
+        def body(c, i):
+            flash, kv, m = c
+            flash, kv, m = hop(i, flash, kv, m)
+            return (flash, kv, m), None
+
+        (carry, _, _), _ = lax.scan(body, (carry, kv, mask_carry), jnp.arange(passes))
+
+    out_g, lse = finalize(carry)
+    out = _ungroup(out_g).astype(q.dtype)
+    return out, lse
+
+
+def _ring_vjp_fwd(
+    q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+    max_ring_passes, window, softclamp_value, scale,
+):
+    out, lse = _ring_fwd_impl(
+        q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+        max_ring_passes, window, softclamp_value, scale,
+    )
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _ring_vjp_bwd(
+    axis_name, causal, striped, bucket_size, max_ring_passes, window,
+    softclamp_value, scale, res, do,
+):
+    q, k, v, kv_mask, out, lse = res
+    b, h, n_local, d = q.shape
+    hk = k.shape[1]
+    if scale is None:
+        scale = d**-0.5
+    ring_size = lax.axis_size(axis_name)
+    passes = min(max_ring_passes or ring_size, ring_size)
+    rank = lax.axis_index(axis_name)
+
+    delta = (
+        _group_q(do, hk).astype(jnp.float32) * _group_q(out, hk).astype(jnp.float32)
+    ).sum(-1)
+
+    kv = jnp.stack([k, v])
+    dkv = match_vma(jnp.zeros((2, b, hk, n_local, d), jnp.float32), q)
+    dq = match_vma(jnp.zeros((b, h, n_local, d), jnp.float32), q)
+    mask_carry = kv_mask
+
+    def hop(i, dq, kv, dkv, mask_carry):
+        origin = (rank - i) % ring_size
+        offset = _hop_offset(rank, origin, n_local, causal, striped)
+        has_work = _hop_has_work(offset, n_local, window)
+
+        def do_bwd(args):
+            dq, dkv = args
+            dq_i, dk_i, dv_i = flash_backward_blocks(
+                do, q, kv[0], kv[1], lse, delta,
+                scale=scale, bucket_size=bucket_size, causal_offset=offset,
+                window=window, kv_mask=mask_carry,
+                softclamp_value=softclamp_value,
+            )
+            return dq + dq_i, dkv.at[0].add(dk_i).at[1].add(dv_i)
+
+        dq, dkv = lax.cond(has_work, do_bwd, lambda a: a, (dq, dkv))
+        kv = _rotate(kv, axis_name)
+        dkv = _rotate(dkv, axis_name)
+        if mask_carry is not None:
+            mask_carry = _rotate(mask_carry, axis_name)
+        return dq, kv, dkv, mask_carry
+
+    if mask_carry is None:
+        def body(c, i):
+            dq, kv, dkv = c
+            dq, kv, dkv, _ = hop(i, dq, kv, dkv, None)
+            return (dq, kv, dkv), None
+
+        (dq, kv, dkv), _ = lax.scan(body, (dq, kv, dkv), jnp.arange(passes))
+    else:
+        def body(c, i):
+            dq, kv, dkv, m = c
+            dq, kv, dkv, m = hop(i, dq, kv, dkv, m)
+            return (dq, kv, dkv, m), None
+
+        (dq, kv, dkv, _), _ = lax.scan(
+            body, (dq, kv, dkv, mask_carry), jnp.arange(passes)
+        )
+
+    # Catch-up rotation: after `passes` end-of-hop rotations the dkv shard on
+    # this device belongs to origin (rank - passes) % ring; one composed
+    # ppermute with shift (ring - passes) returns every shard to its owner
+    # in a single collective (the reference loops single hops instead,
+    # ref ring_flash_attention.py:380-385).
+    shift = (ring_size - passes) % ring_size
+    if shift:
+        perm = [(j, (j + shift) % ring_size) for j in range(ring_size)]
+        dkv = lax.ppermute(dkv, axis_name, perm)
+
+    return (
+        dq.astype(q.dtype),
+        dkv[0].astype(k.dtype),
+        dkv[1].astype(v.dtype),
+        None,
+    )
+
+
+ring_flash_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)

@@ -1,0 +1,81 @@
+"""Sequence layout transforms: padding, striping, and shard specs.
+
+The reference does its resharding with runtime all-gathers
+(``sharded_batch_to_sharded_seq``, ref ``ring_attention.py:223-262``); on TPU
+the same intent is expressed as *layouts*: pure index permutations applied to
+the global array under ``jit``, with ``NamedSharding`` constraints deciding
+which device materializes which slice.  XLA turns the stripe permutation plus
+sharding into the minimal collective — there is no hand-written gather.
+
+Striping (ref ``ring_attention.py:397-401``): device ``r`` of a ``W``-ring
+should hold tokens ``{i * W + r}`` so every hop of causal ring attention has
+equal work (Striped Attention, arXiv 2311.09431).  We stripe at token
+granularity (the reference's fused-kernel ``buckets=1`` case,
+ref ``ring_attention.py:143``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_to_multiple(
+    x: jax.Array, multiple: int, axis: int = 1, value: float = 0.0
+) -> tuple[jax.Array, int]:
+    """Pad ``axis`` up to a multiple; returns (padded, original_length).
+
+    Ref ``ring_attention.py:187-199``.
+    """
+    n = x.shape[axis]
+    rem = n % multiple
+    if rem == 0:
+        return x, n
+    pad = multiple - rem
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value), n
+
+
+def pad_seq_and_mask(
+    x: jax.Array, mask: jax.Array | None, multiple: int
+) -> tuple[jax.Array, jax.Array | None, int]:
+    """Pad tokens and key-padding mask together (ref ``ring_attention.py:201-221``).
+
+    If padding is added and no mask exists, one is created so padded
+    positions never receive attention.
+    """
+    x_padded, n = pad_to_multiple(x, multiple)
+    if x_padded.shape[1] == n and mask is None:
+        return x_padded, None, n
+    if mask is None:
+        mask = jnp.ones(x.shape[:2], bool)
+    mask_padded, _ = pad_to_multiple(mask, multiple, axis=1, value=False)
+    return x_padded, mask_padded, n
+
+
+def stripe_permute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
+    """Reorder sequence so contiguous shards become stripes.
+
+    ``[x0, x1, ..., x_{n-1}] -> [x0, x_W, x_2W, ..., x_1, x_{1+W}, ...]``;
+    sharding the result contiguously over ``W`` devices gives device ``r``
+    tokens ``≡ r (mod W)``.
+    """
+    n = x.shape[axis]
+    assert n % ring_size == 0
+    shape = list(x.shape)
+    new_shape = shape[:axis] + [n // ring_size, ring_size] + shape[axis + 1 :]
+    x = x.reshape(new_shape)
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(shape)
+
+
+def stripe_unpermute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`stripe_permute`."""
+    n = x.shape[axis]
+    assert n % ring_size == 0
+    shape = list(x.shape)
+    new_shape = shape[:axis] + [ring_size, n // ring_size] + shape[axis + 1 :]
+    x = x.reshape(new_shape)
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(shape)

@@ -1,0 +1,38 @@
+"""Test configuration: simulate an 8-device TPU mesh on host CPU.
+
+The reference fakes a cluster with ``mp.spawn`` + gloo (``assert.py:13-25``);
+the JAX-native equivalent is a single process with
+``--xla_force_host_platform_device_count=N`` so every ``Mesh``/``shard_map``
+test runs the exact code that runs on a real TPU slice.
+"""
+
+import os
+
+# Must run before jax initializes its backends (conftest imports first).
+# NOTE: this image pre-imports jax via sitecustomize, so JAX_PLATFORMS in
+# os.environ is already baked; jax.config.update still works pre-backend-init.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
