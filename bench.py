@@ -1,0 +1,132 @@
+"""Benchmark: causal flash attention throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "TFLOPs/chip", "vs_baseline": N, ...}
+
+North-star config (BASELINE.json): seq_len=262144, causal, 8 heads.  The
+reference publishes no performance numbers (BASELINE.md), so
+``vs_baseline`` reports the fraction of the chip's bf16 peak (MFU) —
+a hardware-grounded, round-over-round comparable scalar.
+
+Robustness: each (impl, seq_len) attempt runs in its own subprocess with a
+hard timeout (TPU compiles through this image's remote-compile relay can
+take minutes or hang), falling back to smaller lengths and the pure-XLA
+path; the parent never initializes the TPU and always prints a JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import traceback
+
+TARGET_SEQ = 262144
+HEADS = 8
+DIM_HEAD = 64
+
+# bf16 peak TFLOPs per chip by TPU generation (dense)
+PEAK_TFLOPS = {
+    "v5 lite": 197.0,  # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6e": 918.0,
+}
+
+
+def _worker(impl: str, seq_len: int) -> None:
+    """Runs one timed measurement and prints its own JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
+
+    q = jnp.ones((1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    k = jnp.ones((1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jnp.ones((1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+
+    if impl == "pallas":
+        from ring_attention_tpu.ops.pallas_flash import pallas_flash_attention
+
+        fn = jax.jit(partial(pallas_flash_attention, causal=True))
+    else:
+        from ring_attention_tpu.ops.flash import flash_attention
+
+        bucket = min(1024, seq_len)
+        fn = jax.jit(partial(flash_attention, causal=True, bucket_size=bucket))
+
+    out = fn(q, k, v)
+    jax.block_until_ready(out)
+    iters = 3 if seq_len >= TARGET_SEQ else 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    secs = (time.perf_counter() - t0) / iters
+
+    # causal fwd FLOPs: 2 matmuls x 2 flops x n^2 x h x d x 1/2
+    flops = 2 * 2 * seq_len * seq_len * HEADS * DIM_HEAD * 0.5
+    tflops = flops / secs / 1e12
+    print(
+        json.dumps(
+            {
+                "value": round(tflops, 2),
+                "vs_baseline": round(tflops / peak, 4),
+                "seq_len": seq_len,
+                "impl": impl,
+                "device": getattr(dev, "device_kind", str(dev)),
+                "ms_per_step": round(secs * 1e3, 2),
+            }
+        )
+    )
+
+
+def main() -> None:
+    result = {
+        "metric": f"causal flash attention fwd TFLOPs/chip (h={HEADS}, d={DIM_HEAD}, bf16)",
+        "value": 0.0,
+        "unit": "TFLOPs/chip",
+        "vs_baseline": 0.0,
+    }
+    attempts = [
+        ("pallas", TARGET_SEQ, 1500),
+        ("pallas", 65536, 900),
+        ("pallas", 16384, 600),
+        ("xla", 16384, 900),
+        ("xla", 4096, 600),
+    ]
+    errors = []
+    for impl, seq, budget in attempts:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker", impl, str(seq)],
+                capture_output=True,
+                text=True,
+                timeout=budget,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode == 0:
+                line = proc.stdout.strip().splitlines()[-1]
+                result.update(json.loads(line))
+                break
+            errors.append(f"{impl}@{seq}: rc={proc.returncode} {proc.stderr[-200:]}")
+        except subprocess.TimeoutExpired:
+            errors.append(f"{impl}@{seq}: timeout {budget}s")
+        except Exception:
+            errors.append(f"{impl}@{seq}: {traceback.format_exc(limit=1)}")
+    else:
+        result["error"] = " | ".join(errors)[-500:]
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2], int(sys.argv[3]))
+    else:
+        main()

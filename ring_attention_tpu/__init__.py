@@ -3,19 +3,51 @@
 Built from scratch in JAX/XLA with the capabilities of
 lucidrains/ring-attention-pytorch: ring attention (sequence-parallel exact
 attention over a device mesh via shard_map + ppermute), striped ring
-attention for causal load balance, grouped-query attention, per-layer
-lookback windows, shard-aware rotary embeddings, and RingAttention /
-RingTransformer model layers.
+attention for causal load balance, zig-zag context parallelism (Llama-3
+style), tree-attention single-token decoding over sharded KV caches,
+grouped-query attention, per-layer lookback windows, shard-aware rotary
+embeddings, and RingAttention / RingTransformer model layers.
 """
 
 __version__ = "0.1.0"
 
+from .models import FeedForward, RingAttention, RingTransformer, RMSNorm
 from .ops import (
+    apply_rotary,
     default_attention,
     flash_attention,
+    ring_positions,
+    rotary_freqs,
+)
+from .parallel import (
+    create_mesh,
+    ring_flash_attention,
+    stripe_permute,
+    stripe_unpermute,
+    tree_attn_decode,
+    zigzag_attention,
+    zigzag_permute,
+    zigzag_positions,
+    zigzag_unpermute,
 )
 
 __all__ = [
+    "FeedForward",
+    "RMSNorm",
+    "RingAttention",
+    "RingTransformer",
+    "apply_rotary",
+    "create_mesh",
     "default_attention",
     "flash_attention",
+    "ring_flash_attention",
+    "ring_positions",
+    "rotary_freqs",
+    "stripe_permute",
+    "stripe_unpermute",
+    "tree_attn_decode",
+    "zigzag_attention",
+    "zigzag_permute",
+    "zigzag_positions",
+    "zigzag_unpermute",
 ]

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import default_attention
 from ..ops.flash import flash_attention
+from ..ops.pallas_flash import pallas_flash_attention
 from ..ops.rotary import apply_rotary, ring_positions, rotary_freqs
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.ring import ring_flash_attention
@@ -55,6 +56,7 @@ class RingAttention(nn.Module):
     max_lookback_seq_len: int | None = None
     auto_shard: bool = False
     mesh: Mesh | None = None
+    use_pallas: bool = False
     dtype: jnp.dtype | None = None
 
     def _kv_heads(self) -> int:
@@ -132,6 +134,11 @@ class RingAttention(nn.Module):
                 q, k, v, mask, causal=self.causal,
                 softclamp_value=self.softclamp_value,
             )
+        if self.use_pallas:
+            return pallas_flash_attention(
+                q, k, v, mask, causal=self.causal, window=window,
+                softclamp_value=self.softclamp_value,
+            )
         return flash_attention(
             q, k, v, mask, causal=self.causal, bucket_size=self.bucket_size,
             window=window, softclamp_value=self.softclamp_value,
@@ -186,6 +193,7 @@ class RingAttention(nn.Module):
                 self.causal, self.striped,
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
+                "pallas" if self.use_pallas else "xla",
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
@@ -195,4 +203,7 @@ class RingAttention(nn.Module):
             mesh=self.mesh,
             in_specs=(qspec, qspec, qspec, mspec),
             out_specs=qspec,
+            # pallas_call with device-varying scalars trips jax's vma
+            # checker; jax suggests check_vma=False as the workaround
+            check_vma=not self.use_pallas,
         )(q, k, v, mask)

@@ -47,6 +47,7 @@ class RingTransformer(nn.Module):
     ignore_index: int = -1
     auto_shard: bool = True
     mesh: Mesh | None = None
+    use_pallas: bool = False
     dtype: jnp.dtype | None = None
 
     def _ring_size(self) -> int:
@@ -120,6 +121,7 @@ class RingTransformer(nn.Module):
                     max_lookback_seq_len=lookback,
                     auto_shard=False,  # sharded once at model top
                     mesh=self.mesh,
+                    use_pallas=self.use_pallas,
                     dtype=self.dtype,
                 )(x, mask)
                 + x

@@ -1,0 +1,768 @@
+"""Pallas (Mosaic) flash-attention kernels for TPU.
+
+TPU-native replacement for the reference's Triton kernels
+(``triton_flash_attn.py``): the forward emits the raw online-softmax
+partials ``(acc, m, l)`` so ring hops merge them exactly like the
+reference's ``LOAD_ACCUMULATED`` resume path (ref
+``triton_flash_attn.py:124-165``) — but as a pure-functional merge in XLA
+rather than mutating kernel state, which is the idiom XLA can pipeline
+with the ring ``ppermute``.
+
+Masking uses the same unified *banded causal offset* contract as
+``ops/flash.py`` (plain causal = offset, striped diagonal = 0/-1, windows =
+band width), passed as a runtime scalar in SMEM so one compiled kernel
+serves every ring position under SPMD (the reference compiles
+``CAUSAL_MASK_DIAGONAL`` variants instead, ref ``triton_flash_attn.py:216-221``).
+
+The backward is two kernels without atomics — a dk/dv pass (grid over KV
+blocks, queries streamed) and a dq pass (grid over Q blocks, KV streamed) —
+where the reference's Triton backward needs sequence-parallel
+``atomic_add`` workarounds (ref ``triton_flash_attn.py:763-776``); TPU has
+no relaxed atomics, and the two-pass structure is also what keeps every
+matmul on the MXU with static layouts.
+
+GQA: query heads are served by ``kv_head = q_head // g`` through BlockSpec
+index maps (no materialized repeat); dk/dv are emitted per query head and
+group-summed outside (ref ``ring_flash_attention.py:370-371``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import EPSILON, MASK_VALUE
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+
+
+def _unify_vma(*arrays):
+    """pcast every array to the union of all arrays' shard_map varying axes.
+
+    Inside ``shard_map`` the traced causal offset (derived from
+    ``axis_index``) varies over fewer mesh axes than q/k/v; pallas requires
+    uniform varying-axes types across its operands."""
+    union = set()
+    for a in arrays:
+        if a is not None:
+            union |= set(getattr(jax.typeof(a), "vma", frozenset()))
+
+    def cast(a):
+        if a is None:
+            return None
+        missing = tuple(union - set(getattr(jax.typeof(a), "vma", frozenset())))
+        return lax.pcast(a, missing, to="varying") if missing else a
+
+    return [cast(a) for a in arrays]
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct matching ``like``'s shard_map varying-axes type."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _interpret_default() -> bool:
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True
+
+
+def _block_sizes(nq: int, nk: int, block_q: int | None, block_k: int | None):
+    bq = min(block_q or DEFAULT_BLOCK_Q, nq)
+    bk = min(block_k or DEFAULT_BLOCK_K, nk)
+    while nq % bq:
+        bq //= 2
+    while nk % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+
+def _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed):
+    """Block-level skip predicate: does tile (rows row0.., cols col0..) touch
+    the causal band?  True when not causal."""
+    if not causal:
+        return True
+    offs = offs_ref[0]
+    ok = col0 <= row0 + bq - 1 + offs
+    if windowed:
+        ok = jnp.logical_and(ok, col0 + bk - 1 >= row0 + offs - (offs_ref[1] - 1))
+    return ok
+
+
+def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
+    """Per-element keep mask for a score tile, or None if unmasked.
+
+    ``q_dim`` is the tile dimension holding query rows (0 in fwd/dq tiles,
+    1 in the transposed dk/dv tiles); the other dimension holds key cols.
+    """
+    masked = kvm_ref is not None
+    if not (causal or masked):
+        return None
+    rows = row0 + lax.broadcasted_iota(jnp.int32, shape, q_dim)
+    cols = col0 + lax.broadcasted_iota(jnp.int32, shape, 1 - q_dim)
+    keep = None
+    if causal:
+        offs = offs_ref[0]
+        keep = cols <= rows + offs
+        if windowed:
+            keep = jnp.logical_and(keep, cols >= rows + offs - (offs_ref[1] - 1))
+    if masked:
+        kvm = kvm_ref[0] != 0
+        kvm = kvm[None, :] if q_dim == 0 else kvm[:, None]
+        keep = kvm if keep is None else jnp.logical_and(keep, kvm)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    # scalar prefetch
+    offs_ref,  # (2,) int32: [causal_offset, window] (sentinels if unused)
+    # inputs
+    q_ref,  # (1, bq, d)
+    k_ref,  # (1, bk, d)
+    v_ref,  # (1, bk, d)
+    kvm_ref,  # (1, bk) int8 or None
+    # outputs
+    acc_ref,  # (1, bq, d) f32
+    m_ref,  # (1, bq, 1) f32
+    l_ref,  # (1, bq, 1) f32
+    # scratch
+    acc,  # (bq, d) f32
+    m,  # (bq, 1) f32
+    l,  # (bq, 1) f32
+    *,
+    scale: float,
+    softclamp_value: float | None,
+    causal: bool,
+    windowed: bool,
+    masked: bool,
+    bq: int,
+    bk: int,
+    nk_blocks: int,
+):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, MASK_VALUE)
+        l[:] = jnp.zeros_like(l)
+
+    qi = pl.program_id(1)
+    row0 = qi * bq
+    col0 = ki * bk
+    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
+
+    @pl.when(has_work)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        if softclamp_value is not None:
+            s = jnp.tanh(s / softclamp_value) * softclamp_value
+
+        keep = _tile_keep(
+            offs_ref, row0, col0, (bq, bk), 0, causal, windowed,
+            kvm_ref if masked else None,
+        )
+        if keep is not None:
+            s = jnp.where(keep, s, MASK_VALUE)
+
+        m_prev = m[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l[:] = l[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * alpha + pv
+        m[:] = m_new
+
+    @pl.when(ki == nk_blocks - 1)
+    def _write():
+        acc_ref[0] = acc[:]
+        m_ref[0] = m[:]
+        l_ref[0] = l[:]
+
+
+class FlashPartials(NamedTuple):
+    """Raw online-softmax partials: out = acc / l, lse = m + log l."""
+
+    acc: jax.Array  # (b, h, nq, d) f32
+    m: jax.Array  # (b, h, nq) f32
+    l: jax.Array  # (b, h, nq) f32
+
+
+def pallas_flash_partials(
+    q: jax.Array,  # (b, h, nq, d)
+    k: jax.Array,  # (b, hk, nk, d)
+    v: jax.Array,  # (b, hk, nk, d)
+    kv_mask: jax.Array | None = None,  # (b, nk) bool
+    *,
+    scale: float,
+    causal_offset: jax.Array | int | None = None,
+    window: int | None = None,
+    softclamp_value: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> FlashPartials:
+    """One flash sweep over a KV span, returning mergeable partials."""
+    b, h, nq, d = q.shape
+    _, hk, nk, _ = k.shape
+    g = h // hk
+    bq, bk = _block_sizes(nq, nk, block_q, block_k)
+    interpret = _interpret_default() if interpret is None else interpret
+
+    causal = causal_offset is not None
+    windowed = window is not None and causal
+    masked = kv_mask is not None
+
+    offs = jnp.asarray(
+        [
+            causal_offset if causal else 0,
+            window if windowed else 0,
+        ],
+        jnp.int32,
+    )
+
+    q, k, v, kv_mask, offs = _unify_vma(q, k, v, kv_mask, offs)
+    qr = q.reshape(b * h, nq, d)
+    kr = k.reshape(b * hk, nk, d)
+    vr = v.reshape(b * hk, nk, d)
+
+    def q_map(bh, qi, ki, *_):
+        return (bh, qi, 0)
+
+    def kv_map(bh, qi, ki, *_):
+        b_idx = bh // h
+        kvh = (bh % h) // g
+        return (b_idx * hk + kvh, ki, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
+    ]
+    inputs = [qr, kr, vr]
+    if masked:
+        kvm = kv_mask.astype(jnp.int8)
+        in_specs.append(
+            pl.BlockSpec(
+                (1, bk), lambda bh, qi, ki, *_: (bh // h, ki), memory_space=pltpu.VMEM
+            )
+        )
+        inputs.append(kvm)
+
+    kernel = functools.partial(
+        _fwd_kernel if masked else _fwd_kernel_nomask,
+        scale=scale,
+        softclamp_value=softclamp_value,
+        causal=causal,
+        windowed=windowed,
+        masked=masked,
+        bq=bq,
+        bk=bk,
+        nk_blocks=nk // bk,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, nq // bq, nk // bk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), q_map, memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+    )
+
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((b * h, nq, d), jnp.float32, q),
+            _sds((b * h, nq, 1), jnp.float32, q),
+            _sds((b * h, nq, 1), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(offs, *inputs)
+
+    return FlashPartials(
+        acc.reshape(b, h, nq, d),
+        m.reshape(b, h, nq),
+        l.reshape(b, h, nq),
+    )
+
+
+# variant without the mask ref in the signature (pallas requires the kernel
+# arity to match the number of inputs)
+def _fwd_kernel_nomask(offs_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                       acc, m, l, **kw):
+    _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, None, acc_ref, m_ref, l_ref,
+                acc, m, l, **kw)
+
+
+def init_partials(
+    b: int, h: int, nq: int, d: int, like: jax.Array | None = None
+) -> FlashPartials:
+    """Identity element for :func:`merge_partials` (keeps the MASK_VALUE
+    sentinel invariant local to this module)."""
+    parts = FlashPartials(
+        jnp.zeros((b, h, nq, d), jnp.float32),
+        jnp.full((b, h, nq), MASK_VALUE, jnp.float32),
+        jnp.zeros((b, h, nq), jnp.float32),
+    )
+    if like is not None:
+        parts = FlashPartials(*_unify_vma(*parts, like)[:3])
+    return parts
+
+
+def merge_partials(a: FlashPartials, b: FlashPartials) -> FlashPartials:
+    """Exact online-softmax merge of two partial sweeps (associative)."""
+    m = jnp.maximum(a.m, b.m)
+    ea = jnp.exp(a.m - m)
+    eb = jnp.exp(b.m - m)
+    return FlashPartials(
+        a.acc * ea[..., None] + b.acc * eb[..., None],
+        m,
+        a.l * ea + b.l * eb,
+    )
+
+
+def finalize_partials(p: FlashPartials) -> tuple[jax.Array, jax.Array]:
+    """Returns (out f32 (b,h,n,d), lse (b,h,n))."""
+    out = p.acc / jnp.maximum(p.l, EPSILON)[..., None]
+    lse = p.m + jnp.log(jnp.maximum(p.l, EPSILON))
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(
+    offs_ref,
+    q_ref,  # (1, bq, d)
+    do_ref,  # (1, bq, d)
+    lse_ref,  # (1, bq, 1)
+    delta_ref,  # (1, bq, 1)
+    k_ref,  # (1, bk, d)
+    v_ref,  # (1, bk, d)
+    kvm_ref,  # (1, bk) or None
+    dk_ref,  # (1, bk, d) f32
+    dv_ref,  # (1, bk, d) f32
+    dk,  # scratch (bk, d) f32
+    dv,  # scratch (bk, d) f32
+    *,
+    scale: float,
+    softclamp_value: float | None,
+    causal: bool,
+    windowed: bool,
+    masked: bool,
+    bq: int,
+    bk: int,
+    nq_blocks: int,
+):
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk[:] = jnp.zeros_like(dk)
+        dv[:] = jnp.zeros_like(dv)
+
+    ki = pl.program_id(1)
+    row0 = qi * bq
+    col0 = ki * bk
+    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
+
+    @pl.when(has_work)
+    def _compute():
+        kb = k_ref[0]
+        qb = q_ref[0]
+        # sT: (bk, bq) = k . q^T (contract d on both)
+        sT = lax.dot_general(
+            kb, qb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if softclamp_value is not None:
+            sT = jnp.tanh(sT / softclamp_value) * softclamp_value
+
+        pT = jnp.exp(sT - jnp.swapaxes(lse_ref[0], 0, 1))
+        keep = _tile_keep(
+            offs_ref, row0, col0, (bk, bq), 1, causal, windowed,
+            kvm_ref if masked else None,
+        )
+        if keep is not None:
+            pT = jnp.where(keep, pT, 0.0)
+
+        dob = do_ref[0]
+        dv[:] = dv[:] + lax.dot_general(
+            pT.astype(dob.dtype), dob, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dpT: (bk, bq) = v . do^T
+        dpT = lax.dot_general(
+            v_ref[0], dob, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dsT = pT * (dpT - jnp.swapaxes(delta_ref[0], 0, 1))
+        if softclamp_value is not None:
+            dsT = dsT * (1.0 - (sT / softclamp_value) ** 2)
+        dsT = dsT * scale
+        dk[:] = dk[:] + lax.dot_general(
+            dsT.astype(qb.dtype), qb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq_blocks - 1)
+    def _write():
+        dk_ref[0] = dk[:]
+        dv_ref[0] = dv[:]
+
+
+def _bwd_dkv_kernel_nomask(offs_ref, q_ref, do_ref, lse_ref, delta_ref,
+                           k_ref, v_ref, dk_ref, dv_ref, dk, dv, **kw):
+    _bwd_dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                    None, dk_ref, dv_ref, dk, dv, **kw)
+
+
+def _bwd_dq_kernel(
+    offs_ref,
+    q_ref,  # (1, bq, d)
+    do_ref,  # (1, bq, d)
+    lse_ref,  # (1, bq, 1)
+    delta_ref,  # (1, bq, 1)
+    k_ref,  # (1, bk, d)
+    v_ref,  # (1, bk, d)
+    kvm_ref,  # (1, bk) or None
+    dq_ref,  # (1, bq, d) f32
+    dq,  # scratch (bq, d) f32
+    *,
+    scale: float,
+    softclamp_value: float | None,
+    causal: bool,
+    windowed: bool,
+    masked: bool,
+    bq: int,
+    bk: int,
+    nk_blocks: int,
+):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq[:] = jnp.zeros_like(dq)
+
+    qi = pl.program_id(1)
+    row0 = qi * bq
+    col0 = ki * bk
+    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
+
+    @pl.when(has_work)
+    def _compute():
+        qb = q_ref[0]
+        kb = k_ref[0]
+        s = lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if softclamp_value is not None:
+            s = jnp.tanh(s / softclamp_value) * softclamp_value
+
+        p = jnp.exp(s - lse_ref[0])
+        keep = _tile_keep(
+            offs_ref, row0, col0, (bq, bk), 0, causal, windowed,
+            kvm_ref if masked else None,
+        )
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+
+        dob = do_ref[0]
+        dp = lax.dot_general(
+            dob, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        if softclamp_value is not None:
+            ds = ds * (1.0 - (s / softclamp_value) ** 2)
+        ds = ds * scale
+        dq[:] = dq[:] + lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk_blocks - 1)
+    def _write():
+        dq_ref[0] = dq[:]
+
+
+def _bwd_dq_kernel_nomask(offs_ref, q_ref, do_ref, lse_ref, delta_ref,
+                          k_ref, v_ref, dq_ref, dq, **kw):
+    _bwd_dq_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                   None, dq_ref, dq, **kw)
+
+
+def pallas_flash_backward(
+    do: jax.Array,  # (b, h, nq, d)
+    q: jax.Array,
+    k: jax.Array,  # (b, hk, nk, d)
+    v: jax.Array,
+    lse: jax.Array,  # (b, h, nq) f32
+    delta: jax.Array,  # (b, h, nq) f32
+    kv_mask: jax.Array | None = None,
+    *,
+    scale: float,
+    causal_offset: jax.Array | int | None = None,
+    window: int | None = None,
+    softclamp_value: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Two-pass flash backward. Returns (dq, dk, dv), all f32, dk/dv with
+    ``hk`` heads (GQA group-summed)."""
+    b, h, nq, d = q.shape
+    _, hk, nk, _ = k.shape
+    g = h // hk
+    bq, bk = _block_sizes(nq, nk, block_q, block_k)
+    interpret = _interpret_default() if interpret is None else interpret
+
+    causal = causal_offset is not None
+    windowed = window is not None and causal
+    masked = kv_mask is not None
+    offs = jnp.asarray(
+        [causal_offset if causal else 0, window if windowed else 0], jnp.int32
+    )
+
+    q, k, v, do, lse, delta, kv_mask, offs = _unify_vma(
+        q, k, v, do, lse, delta, kv_mask, offs
+    )
+    qr = q.reshape(b * h, nq, d)
+    dor = do.reshape(b * h, nq, d).astype(q.dtype)
+    lser = lse.reshape(b * h, nq, 1)
+    deltar = delta.reshape(b * h, nq, 1)
+    kr = k.reshape(b * hk, nk, d)
+    vr = v.reshape(b * hk, nk, d)
+
+    def q_map(bh, xi, yi, *_):
+        del yi
+        return (bh, xi, 0)
+
+    def q_map_inner(bh, ki, qi, *_):
+        del ki
+        return (bh, qi, 0)
+
+    def kv_map_outer(bh, ki, qi, *_):
+        del qi
+        b_idx = bh // h
+        kvh = (bh % h) // g
+        return (b_idx * hk + kvh, ki, 0)
+
+    def kv_map_inner(bh, qi, ki, *_):
+        b_idx = bh // h
+        kvh = (bh % h) // g
+        return (b_idx * hk + kvh, ki, 0)
+
+    common = dict(
+        scale=scale,
+        softclamp_value=softclamp_value,
+        causal=causal,
+        windowed=windowed,
+        masked=masked,
+        bq=bq,
+        bk=bk,
+    )
+
+    # ---- dk/dv pass: grid (bh, k blocks, q blocks) ----
+    in_specs = [
+        pl.BlockSpec((1, bq, d), q_map_inner, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, d), q_map_inner, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), q_map_inner, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), q_map_inner, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map_outer, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map_outer, memory_space=pltpu.VMEM),
+    ]
+    inputs = [qr, dor, lser, deltar, kr, vr]
+    if masked:
+        kvm = kv_mask.astype(jnp.int8)
+        in_specs.append(
+            pl.BlockSpec(
+                (1, bk), lambda bh, ki, qi, *_: (bh // h, ki), memory_space=pltpu.VMEM
+            )
+        )
+        inputs.append(kvm)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel if masked else _bwd_dkv_kernel_nomask,
+        nq_blocks=nq // bq,
+        **common,
+    )
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, nk // bk, nq // bq),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda bh, ki, qi, *_: (bh, ki, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk, d), lambda bh, ki, qi, *_: (bh, ki, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            _sds((b * h, nk, d), jnp.float32, q),
+            _sds((b * h, nk, d), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(offs, *inputs)
+
+    # GQA: sum per-query-head dk/dv over the group
+    dk = dk_h.reshape(b, hk, g, nk, d).sum(axis=2)
+    dv = dv_h.reshape(b, hk, g, nk, d).sum(axis=2)
+
+    # ---- dq pass: grid (bh, q blocks, k blocks) ----
+    in_specs = [
+        pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq, 1), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map_inner, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map_inner, memory_space=pltpu.VMEM),
+    ]
+    inputs = [qr, dor, lser, deltar, kr, vr]
+    if masked:
+        inputs.append(kvm)
+        in_specs.append(
+            pl.BlockSpec(
+                (1, bk), lambda bh, qi, ki, *_: (bh // h, ki), memory_space=pltpu.VMEM
+            )
+        )
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel if masked else _bwd_dq_kernel_nomask,
+        nk_blocks=nk // bk,
+        **common,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, nq // bq, nk // bk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=_sds((b * h, nq, d), jnp.float32, q),
+        interpret=interpret,
+    )(offs, *inputs)
+
+    return dq.reshape(b, h, nq, d), dk, dv
+
+
+# ---------------------------------------------------------------------------
+# User-facing single-device flash attention on the Pallas path
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _pallas_flash_core(q, k, v, kv_mask, scale, causal_offset, window,
+                       softclamp_value, interpret):
+    out, _ = _pallas_flash_fwd_impl(
+        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value, interpret
+    )
+    return out
+
+
+def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
+                           softclamp_value, interpret):
+    parts = pallas_flash_partials(
+        q, k, v, kv_mask,
+        scale=scale, causal_offset=causal_offset, window=window,
+        softclamp_value=softclamp_value, interpret=interpret,
+    )
+    out, lse = finalize_partials(parts)
+    return out.astype(q.dtype), lse
+
+
+def _pallas_flash_core_fwd(q, k, v, kv_mask, scale, causal_offset, window,
+                           softclamp_value, interpret):
+    out, lse = _pallas_flash_fwd_impl(
+        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value, interpret
+    )
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _pallas_flash_core_bwd(scale, causal_offset, window, softclamp_value,
+                           interpret, res, do):
+    q, k, v, kv_mask, out, lse = res
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    dq, dk, dv = pallas_flash_backward(
+        do, q, k, v, lse, delta, kv_mask,
+        scale=scale, causal_offset=causal_offset, window=window,
+        softclamp_value=softclamp_value, interpret=interpret,
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
+
+
+_pallas_flash_core.defvjp(_pallas_flash_core_fwd, _pallas_flash_core_bwd)
+
+
+def pallas_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    *,
+    causal: bool = False,
+    window: int | None = None,
+    softclamp_value: float | None = None,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Exact flash attention on the Pallas TPU kernel path (GQA-aware).
+
+    Same contract as ``ops.flash.flash_attention``; parity-tested against
+    the oracle.  On non-TPU backends runs the kernels in interpreter mode.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if window is not None:
+        assert causal, "lookback windows require causal attention"
+    if causal:
+        mask = None
+    causal_offset = k.shape[2] - q.shape[2] if causal else None
+    return _pallas_flash_core(
+        q, k, v, mask, scale, causal_offset, window, softclamp_value,
+        interpret if interpret is not None else _interpret_default(),
+    )

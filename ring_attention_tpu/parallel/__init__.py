@@ -1,5 +1,12 @@
 from .mesh import DATA_AXIS, SEQ_AXIS, create_mesh, replicated, seq_sharding
 from .ring import ring_flash_attention
+from .tree_decode import tree_attn_decode
+from .zigzag import (
+    zigzag_attention,
+    zigzag_permute,
+    zigzag_positions,
+    zigzag_unpermute,
+)
 from .sharding import (
     pad_seq_and_mask,
     pad_to_multiple,
@@ -14,6 +21,11 @@ __all__ = [
     "replicated",
     "seq_sharding",
     "ring_flash_attention",
+    "tree_attn_decode",
+    "zigzag_attention",
+    "zigzag_permute",
+    "zigzag_positions",
+    "zigzag_unpermute",
     "pad_seq_and_mask",
     "pad_to_multiple",
     "stripe_permute",

@@ -1,16 +1,24 @@
 """Ring attention over a TPU mesh axis: `lax.ppermute` + online softmax.
 
 TPU-native redesign of the reference's L1+L3 (``ring.py`` /
-``ring_flash_attention.py`` in lucidrains/ring-attention-pytorch).  The
-reference hand-rolls a P2P ring (batched isend/irecv + barrier per hop,
-``ring.py:51-60``) and a hand-written autograd Function
-(``ring_flash_attention.py:60-387``).  Here the entire communication layer is
-one collective — ``lax.ppermute`` over a named mesh axis inside ``shard_map``
-— which XLA pipelines with the per-hop flash compute (the overlap the
-reference explicitly lacks), and differentiation is a ``jax.custom_vjp``
-whose backward rotates ``(k, v, dk, dv)`` together, finishing with the
-catch-up rotation that returns partial dk/dv to their owner shard when
-``max_ring_passes`` limits the loop (ref ``ring_flash_attention.py:380-385``).
+``ring_flash_attention.py`` / ``ring_flash_attention_cuda.py`` in
+lucidrains/ring-attention-pytorch).  The reference hand-rolls a P2P ring
+(batched isend/irecv + barrier per hop, ``ring.py:51-60``) and hand-written
+autograd Functions (``ring_flash_attention.py:60-387``).  Here the entire
+communication layer is one collective — ``lax.ppermute`` over a named mesh
+axis inside ``shard_map`` — which XLA pipelines with the per-hop flash
+compute (the overlap the reference explicitly lacks), and differentiation
+is a ``jax.custom_vjp`` whose backward rotates ``(k, v, dk, dv)`` together,
+finishing with a single composed catch-up ppermute that returns partial
+dk/dv to their owner shard when ``max_ring_passes`` limits the loop
+(ref ``ring_flash_attention.py:380-385``).
+
+Two interchangeable per-hop compute paths (the reference's naive/Triton
+split, ``ring_attention.py:424-451``):
+
+  - ``impl="xla"``   — blockwise jnp flash (``ops/flash.py``), runs anywhere;
+  - ``impl="pallas"`` — Mosaic kernels (``ops/pallas_flash.py``) emitting
+    mergeable ``(acc, m, l)`` partials, the performance path on TPU.
 
 Ring-set math (multiple independent rings inside one world,
 ref ``ring.py:35-47``) needs no code at all: ppermute over the ``seq`` mesh
@@ -19,7 +27,7 @@ axis is automatically scoped per row of the ``(data, seq)`` mesh.
 Masking unification (see ``ops/flash.py``): each hop computes a single
 *causal offset* scalar from ``(my_rank, origin_rank)``:
 
-  - plain causal:   ``offset = (rank - origin) * n_local``  — covers
+  - plain causal:   ``offset = (rank - origin) * n_local`` — covers
     "skip hop entirely" (origin > rank), "triangular" (origin == rank) and
     "fully visible" (origin < rank) in one expression
     (ref ``ring_flash_attention.py:177-192``).
@@ -29,7 +37,7 @@ Masking unification (see ``ops/flash.py``): each hop computes a single
 
 Hops that provably contribute nothing (plain causal, origin ahead of rank;
 or beyond the lookback window) skip their compute through ``lax.cond`` —
-the per-device branch is resolved at run time from ``axis_index``, while the
+the per-device branch resolves at run time from ``axis_index``, while the
 ppermute stays outside the cond so the collective schedule is identical on
 every device.
 """
@@ -37,14 +45,12 @@ every device.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash import (
-    FlashCarry,
     attend_blocks,
     finalize,
     flash_backward_blocks,
@@ -53,13 +59,18 @@ from ..ops.flash import (
     _group_q,
     _ungroup,
 )
+from ..ops.pallas_flash import (
+    finalize_partials,
+    init_partials,
+    merge_partials,
+    pallas_flash_backward,
+    pallas_flash_partials,
+)
 
 
-def _ring_perm(axis_name: str) -> list[tuple[int, int]]:
-    # psum of ones is the SPMD-safe way to get the axis size as a python int
-    # at trace time; axis sizes are always static in shard_map.
+def _ring_perm(axis_name: str, shift: int = 1) -> list[tuple[int, int]]:
     size = lax.axis_size(axis_name)
-    return [(j, (j + 1) % size) for j in range(size)]
+    return [(j, (j + shift) % size) for j in range(size)]
 
 
 def _rotate(x, axis_name: str):
@@ -92,9 +103,73 @@ def _hop_has_work(
     return lo
 
 
+def _span_ops(impl, q, hk, scale, bucket_size, window, softclamp_value):
+    """Per-hop (init, attend, final) for the chosen compute path.
+
+    The carry is the online-softmax state; ``attend`` folds one KV span
+    (the currently-held ring block) into it.
+    """
+    b, h, n_local, d = q.shape
+    g = h // hk
+
+    if impl == "pallas":
+
+        def init():
+            return init_partials(b, h, n_local, d, like=q)
+
+        def attend(carry, k, v, kv_mask, offset):
+            parts = pallas_flash_partials(
+                q, k, v, kv_mask,
+                scale=scale, causal_offset=offset, window=window,
+                softclamp_value=softclamp_value,
+                block_q=bucket_size, block_k=bucket_size,
+            )
+            return merge_partials(carry, parts)
+
+        def final(carry):
+            out, lse = finalize_partials(carry)  # lse: (b, h, n)
+            return out.astype(q.dtype), lse
+
+    else:
+
+        def init():
+            return init_carry(b, hk, g, n_local, d, like=q)
+
+        def attend(carry, k, v, kv_mask, offset):
+            return attend_blocks(
+                q, k, v, carry,
+                scale=scale, bucket_size=bucket_size, causal_offset=offset,
+                window=window, kv_mask=kv_mask,
+                softclamp_value=softclamp_value,
+            )
+
+        def final(carry):
+            out_g, lse = finalize(carry)  # lse: (b, hk, g, n)
+            return _ungroup(out_g).astype(q.dtype), lse
+
+    return init, attend, final
+
+
+def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, offset, scale,
+              bucket_size, window, softclamp_value, hk):
+    """Per-hop backward: returns (dq (b,h,..), dk (b,hk,..), dv (b,hk,..))."""
+    if impl == "pallas":
+        return pallas_flash_backward(
+            do, q, k, v, lse, delta, kv_mask,
+            scale=scale, causal_offset=offset, window=window,
+            softclamp_value=softclamp_value,
+            block_q=bucket_size, block_k=bucket_size,
+        )
+    return flash_backward_blocks(
+        do, q, k, v, lse, delta,
+        scale=scale, bucket_size=bucket_size, causal_offset=offset,
+        window=window, kv_mask=kv_mask, softclamp_value=softclamp_value,
+    )
+
+
 @partial(
     jax.custom_vjp,
-    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11),
+    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12),
 )
 def ring_flash_attention(
     q: jax.Array,
@@ -109,6 +184,7 @@ def ring_flash_attention(
     window: int | None = None,
     softclamp_value: float | None = None,
     scale: float | None = None,
+    impl: str = "xla",
 ) -> jax.Array:
     """Sequence-parallel exact attention; call inside ``shard_map``.
 
@@ -126,31 +202,44 @@ def ring_flash_attention(
       max_ring_passes: limit hops for per-layer lookback windows
         (ref ``ring_flash_attention.py:95-103``).
       window: exact sliding-window lookback in tokens (non-striped only).
+      impl: per-hop compute path, ``"xla"`` or ``"pallas"``.
 
     Returns:
       ``(b, h, n_local, d)`` output shard, in ``q.dtype``.
     """
     out, _ = _ring_fwd_impl(
         q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale,
+        max_ring_passes, window, softclamp_value, scale, impl,
     )
     return out
 
 
+def _check_window(causal, striped, window):
+    if window is not None:
+        assert causal, "lookback windows require causal attention"
+        assert not striped, (
+            "windows apply to contiguous (non-striped) layouts; striped "
+            "lookback is approximated with max_ring_passes instead"
+        )
+
+
 def _ring_fwd_impl(
     q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-    max_ring_passes, window, softclamp_value, scale,
+    max_ring_passes, window, softclamp_value, scale, impl,
 ):
+    _check_window(causal, striped, window)
     b, h, n_local, d = q.shape
     hk = k.shape[1]
-    g = h // hk
     if scale is None:
         scale = d**-0.5
     ring_size = lax.axis_size(axis_name)
     passes = min(max_ring_passes or ring_size, ring_size)
     rank = lax.axis_index(axis_name)
 
-    carry = init_carry(b, hk, g, n_local, d, like=q)
+    init, attend, final = _span_ops(
+        impl, q, hk, scale, bucket_size, window, softclamp_value
+    )
+    carry = init()
     kv = jnp.stack([k, v])  # one message per hop, ref ring_flash_attention.py:129
     mask_carry = kv_mask
 
@@ -159,15 +248,12 @@ def _ring_fwd_impl(
         offset = _hop_offset(rank, origin, n_local, causal, striped)
         has_work = _hop_has_work(offset, n_local, window)
 
-        def do_attend(flash):
-            return attend_blocks(
-                q, kv[0], kv[1], flash,
-                scale=scale, bucket_size=bucket_size, causal_offset=offset,
-                window=window, kv_mask=mask_carry,
-                softclamp_value=softclamp_value,
-            )
-
-        flash = lax.cond(has_work, do_attend, lambda f: f, flash)
+        flash = lax.cond(
+            has_work,
+            lambda f: attend(f, kv[0], kv[1], mask_carry, offset),
+            lambda f: f,
+            flash,
+        )
         # rotate AFTER compute; collective outside the cond so the schedule
         # is uniform across devices
         kv = _rotate(kv, axis_name)
@@ -190,25 +276,23 @@ def _ring_fwd_impl(
 
         (carry, _, _), _ = lax.scan(body, (carry, kv, mask_carry), jnp.arange(passes))
 
-    out_g, lse = finalize(carry)
-    out = _ungroup(out_g).astype(q.dtype)
-    return out, lse
+    return final(carry)
 
 
 def _ring_vjp_fwd(
     q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-    max_ring_passes, window, softclamp_value, scale,
+    max_ring_passes, window, softclamp_value, scale, impl,
 ):
     out, lse = _ring_fwd_impl(
         q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale,
+        max_ring_passes, window, softclamp_value, scale, impl,
     )
     return out, (q, k, v, kv_mask, out, lse)
 
 
 def _ring_vjp_bwd(
     axis_name, causal, striped, bucket_size, max_ring_passes, window,
-    softclamp_value, scale, res, do,
+    softclamp_value, scale, impl, res, do,
 ):
     q, k, v, kv_mask, out, lse = res
     b, h, n_local, d = q.shape
@@ -219,9 +303,14 @@ def _ring_vjp_bwd(
     passes = min(max_ring_passes or ring_size, ring_size)
     rank = lax.axis_index(axis_name)
 
-    delta = (
-        _group_q(do, hk).astype(jnp.float32) * _group_q(out, hk).astype(jnp.float32)
-    ).sum(-1)
+    if impl == "pallas":
+        # lse/delta in (b, h, n) layout
+        delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    else:
+        delta = (
+            _group_q(do, hk).astype(jnp.float32)
+            * _group_q(out, hk).astype(jnp.float32)
+        ).sum(-1)
 
     kv = jnp.stack([k, v])
     dkv = match_vma(jnp.zeros((2, b, hk, n_local, d), jnp.float32), q)
@@ -235,11 +324,9 @@ def _ring_vjp_bwd(
 
         def do_bwd(args):
             dq, dkv = args
-            dq_i, dk_i, dv_i = flash_backward_blocks(
-                do, q, kv[0], kv[1], lse, delta,
-                scale=scale, bucket_size=bucket_size, causal_offset=offset,
-                window=window, kv_mask=mask_carry,
-                softclamp_value=softclamp_value,
+            dq_i, dk_i, dv_i = _span_bwd(
+                impl, do, q, kv[0], kv[1], lse, delta, mask_carry, offset,
+                scale, bucket_size, window, softclamp_value, hk,
             )
             return dq + dq_i, dkv.at[0].add(dk_i).at[1].add(dv_i)
 
@@ -274,8 +361,7 @@ def _ring_vjp_bwd(
     # ref ring_flash_attention.py:380-385).
     shift = (ring_size - passes) % ring_size
     if shift:
-        perm = [(j, (j + shift) % ring_size) for j in range(ring_size)]
-        dkv = lax.ppermute(dkv, axis_name, perm)
+        dkv = lax.ppermute(dkv, axis_name, _ring_perm(axis_name, shift))
 
     return (
         dq.astype(q.dtype),

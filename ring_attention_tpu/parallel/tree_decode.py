@@ -1,0 +1,70 @@
+"""Tree-attention single-token decoding over sharded KV.
+
+TPU-native equivalent of the reference's ``tree_attn_decoding.py``: at decode
+time the query is one token (replicated) while the KV cache is sharded over
+devices; each device computes its local flash partial ``(acc, m, l)`` and the
+partials merge with three collectives — MAX over the running max, SUM over
+the rescaled numerator and denominator (ref ``tree_attn_decoding.py:87-102``).
+
+On a TPU pod ``pmax``/``psum`` ride ICI with topology-aware reductions, the
+two-level tree the paper (and the reference's comment) describe — XLA builds
+the hierarchy, no hand-written intra/inter-node split needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import EPSILON
+from ..ops.flash import attend_blocks, init_carry, _ungroup
+
+
+def tree_attn_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None = None,
+    *,
+    axis_name: str,
+    bucket_size: int | None = None,
+    softclamp_value: float | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Single(-few)-token decode attention; call inside ``shard_map``.
+
+    Args:
+      q: ``(b, h, nq, d)`` queries, replicated across ``axis_name``
+        (``nq`` is typically 1).
+      k, v: ``(b, hk, n_local, d)`` local KV-cache shards (GQA supported).
+      kv_mask: optional ``(b, n_local)`` mask for padded cache slots —
+        the static-shape answer to the reference's ragged "rank holds no KV"
+        edge case (ref ``tree_attn_decoding.py:81-85``): pad the cache and
+        mask the tail.
+
+    Returns:
+      ``(b, h, nq, d)`` decoded output, replicated across ``axis_name``.
+    """
+    b, h, nq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    if scale is None:
+        scale = d**-0.5
+
+    # local online-softmax partial over the KV shard
+    carry = init_carry(b, hk, g, nq, d, like=k)
+    carry = attend_blocks(
+        q, k, v, carry,
+        scale=scale, bucket_size=bucket_size, kv_mask=kv_mask,
+        softclamp_value=softclamp_value,
+    )
+    acc, m, l = carry
+
+    # three-collective merge (ref tree_attn_decoding.py:89-100)
+    m_global = lax.pmax(m, axis_name)
+    correction = jnp.exp(m - m_global)
+    num = lax.psum(acc * correction[..., None], axis_name)
+    den = lax.psum(l * correction, axis_name)
+    out = num / jnp.maximum(den, EPSILON)[..., None]
+    return _ungroup(out).astype(q.dtype)

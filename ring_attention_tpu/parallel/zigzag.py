@@ -1,0 +1,143 @@
+"""Zig-zag context parallelism (Llama-3 style CP).
+
+TPU-native equivalent of the reference's ``zig_zag_attention.py``: the
+sequence is cut into ``2 * ring_size`` chunks and device ``r`` owns chunks
+``(r, 2W-1-r)`` so causal work is balanced (ref ``zig_zag_attention.py:65-69``);
+attention all-gathers K/V over the sequence axis and applies an explicit
+causal mask derived from chunk positions (ref ``zig_zag_attention.py:121-139``).
+
+Differences by design:
+  - the chunk permutation is a pure static reshape/transpose applied to the
+    global array before sharding (no gather pipeline, no closure-based
+    inverse — ref ``zig_zag_attention.py:84-98``);
+  - inside ``shard_map`` the gathered K/V are un-permuted back to canonical
+    order (static slice reorder), so the causal mask for each of the two
+    local query chunks is a plain end-aligned band and the compute reuses
+    the blockwise flash kernel (``ops/flash.py``) instead of materializing
+    an ``(n_local, n_global)`` boolean mask;
+  - gradients flow through ``lax.all_gather``'s transpose (reduce-scatter),
+    the analogue of the reference's autograd AllGather backward
+    (ref ``distributed.py:103-107``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.flash import attend_blocks, finalize, init_carry, _ungroup
+
+
+def zigzag_permute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
+    """Reorder sequence chunks ``[0..2W)`` to ``[0, 2W-1, 1, 2W-2, ...]``.
+
+    Sharding the result contiguously over ``W`` devices gives device ``r``
+    chunks ``(r, 2W-1-r)`` (ref ``zig_zag_attention.py:65-69``).
+    """
+    n = x.shape[axis]
+    assert n % (2 * ring_size) == 0, "sequence must divide into 2*ring chunks"
+    chunk = n // (2 * ring_size)
+    idx = []
+    for r in range(ring_size):
+        idx.extend([r, 2 * ring_size - 1 - r])
+    x = _chunk_take(x, idx, chunk, axis)
+    return x
+
+
+def zigzag_unpermute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_permute`."""
+    n = x.shape[axis]
+    chunk = n // (2 * ring_size)
+    order = []
+    for r in range(ring_size):
+        order.extend([r, 2 * ring_size - 1 - r])
+    inv = [0] * len(order)
+    for pos, c in enumerate(order):
+        inv[c] = pos
+    return _chunk_take(x, inv, chunk, axis)
+
+
+def _chunk_take(x: jax.Array, chunk_order: list[int], chunk: int, axis: int) -> jax.Array:
+    shape = list(x.shape)
+    nchunks = len(chunk_order)
+    x = x.reshape(shape[:axis] + [nchunks, chunk] + shape[axis + 1 :])
+    x = jnp.take(x, jnp.asarray(chunk_order), axis=axis)
+    return x.reshape(shape)
+
+
+def zigzag_chunk_starts(ring_size: int, n_global: int) -> jnp.ndarray:
+    """Global start position of each device's two chunks, shape (W, 2)."""
+    chunk = n_global // (2 * ring_size)
+    starts = []
+    for r in range(ring_size):
+        starts.append([r * chunk, (2 * ring_size - 1 - r) * chunk])
+    return jnp.asarray(starts)
+
+
+def zigzag_positions(n_local: int, rank: jax.Array, ring_size: int) -> jax.Array:
+    """Global token positions of a zig-zag shard (for rotary / masks).
+
+    Local layout is ``[chunk rank, chunk 2W-1-rank]``; the reference returns
+    the same indices from ``zig_zag_shard`` (ref ``zig_zag_attention.py:73-80``).
+    """
+    chunk = n_local // 2
+    i = jnp.arange(chunk)
+    first = rank * chunk + i
+    second = (2 * ring_size - 1 - rank) * chunk + i
+    return jnp.concatenate([first, second])
+
+
+def zigzag_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    bucket_size: int | None = None,
+    softclamp_value: float | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Zig-zag sharded attention; call inside ``shard_map``.
+
+    ``q, k, v: (b, [h|hk], n_local, d)`` local shards in zig-zag layout
+    (``n_local = 2 * chunk``).  K/V are all-gathered over ``axis_name`` and
+    un-permuted to canonical order; each local query chunk then attends its
+    end-aligned causal prefix via blockwise flash.
+    """
+    assert causal, "zig-zag CP is a causal-load-balancing scheme (ref zig_zag_attention.py:102-103)"
+    b, h, n_local, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    if scale is None:
+        scale = d**-0.5
+    ring_size = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    chunk = n_local // 2
+    n_global = n_local * ring_size
+
+    # gather K/V over sequence: (b, hk, n_global, d) in zig-zag shard order
+    k_all = lax.all_gather(k, axis_name, axis=2, tiled=True)
+    v_all = lax.all_gather(v, axis_name, axis=2, tiled=True)
+    # static un-permute back to canonical sequence order
+    k_all = zigzag_unpermute(k_all, ring_size, axis=2)
+    v_all = zigzag_unpermute(v_all, ring_size, axis=2)
+
+    outs = []
+    for which, start_expr in enumerate(
+        (rank * chunk, (2 * ring_size - 1 - rank) * chunk)
+    ):
+        qc = lax.dynamic_slice_in_dim(q, which * chunk, chunk, axis=2)
+        # causal band, end-aligned to the chunk's global end: local row i
+        # (global start_expr + i) sees keys j <= start_expr + i
+        carry = init_carry(b, hk, g, chunk, d, like=qc)
+        carry = attend_blocks(
+            qc, k_all, v_all, carry,
+            scale=scale, bucket_size=bucket_size,
+            causal_offset=start_expr,
+            softclamp_value=softclamp_value,
+        )
+        out_g, _ = finalize(carry)
+        outs.append(_ungroup(out_g))
+    return jnp.concatenate(outs, axis=2).astype(q.dtype)

@@ -31,7 +31,7 @@ def make_pair(mesh, **kw):
     ring_model = RingTransformer(use_ring=True, mesh=mesh, **common)
     ref_model = RingTransformer(
         use_ring=False, force_regular_attn=True,
-        **{k: v for k, v in common.items() if k != "striped"},
+        **{k: v for k, v in common.items() if k not in ("striped", "use_pallas")},
     )
     return ring_model, ref_model
 
@@ -125,4 +125,15 @@ def test_odd_bucket_interaction(rng, mesh):
     params = ref_model.init(jax.random.PRNGKey(0), tokens)
     np.testing.assert_allclose(
         ring_model.apply(params, tokens), ref_model.apply(params, tokens), atol=ATOL
+    )
+
+
+def test_pallas_transformer_parity(rng, mesh):
+    """End-to-end transformer on the Pallas kernel path (interpret on CPU)."""
+    ring_model, ref_model = make_pair(mesh, striped=True, use_pallas=True)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 64)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    np.testing.assert_allclose(
+        ring_model.apply(params, tokens), ref_model.apply(params, tokens),
+        atol=ATOL,
     )
