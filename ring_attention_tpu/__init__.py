@@ -12,6 +12,7 @@ embeddings, and RingAttention / RingTransformer model layers.
 __version__ = "0.1.0"
 
 from .models import FeedForward, RingAttention, RingTransformer, RMSNorm
+from .utils import StepTimer, restore_checkpoint, save_checkpoint, trace
 from .ops import (
     apply_rotary,
     default_attention,
@@ -20,6 +21,9 @@ from .ops import (
     rotary_freqs,
 )
 from .parallel import (
+    all_gather_variable,
+    axis_rank,
+    axis_world,
     create_mesh,
     ring_flash_attention,
     stripe_permute,
@@ -33,6 +37,13 @@ from .parallel import (
 
 __all__ = [
     "FeedForward",
+    "StepTimer",
+    "all_gather_variable",
+    "axis_rank",
+    "axis_world",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "trace",
     "RMSNorm",
     "RingAttention",
     "RingTransformer",

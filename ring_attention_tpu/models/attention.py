@@ -10,12 +10,16 @@ shard, ref ``ring_attention.py:389-403``) but expressed as layouts: a pure
 stripe permutation plus a ``NamedSharding`` constraint; XLA inserts the
 minimal collective instead of a hand-written all-gather
 (cf. ``sharded_batch_to_sharded_seq``, ref ``ring_attention.py:223-262``).
+
+Beyond the reference: ``decode_step`` — single-token incremental decoding
+against a KV cache sharded over the ring, merged with tree attention
+(the reference ships ``tree_attn_decode`` standalone only,
+ref ``tree_attn_decoding.py:23-103``).
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import flax.linen as nn
 import jax
@@ -30,6 +34,7 @@ from ..ops.rotary import apply_rotary, ring_positions, rotary_freqs
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.ring import ring_flash_attention
 from ..parallel.sharding import pad_seq_and_mask, stripe_permute, stripe_unpermute
+from ..parallel.tree_decode import tree_attn_decode
 from .layers import RMSNorm
 
 
@@ -59,6 +64,14 @@ class RingAttention(nn.Module):
     use_pallas: bool = False
     dtype: jnp.dtype | None = None
 
+    def setup(self):
+        h, kvh, dh = self.heads, self._kv_heads(), self.dim_head
+        self.prenorm = RMSNorm(self.dim)
+        self.to_qkv = nn.Dense(
+            (h + 2 * kvh) * dh, use_bias=False, dtype=self.dtype
+        )
+        self.to_out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype)
+
     def _kv_heads(self) -> int:
         kvh = self.kv_heads or self.heads
         assert self.heads % kvh == 0
@@ -69,7 +82,17 @@ class RingAttention(nn.Module):
             return 1
         return self.mesh.shape[SEQ_AXIS]
 
-    @nn.compact
+    def _project_qkv(self, x: jax.Array):
+        """prenorm + fused qkv -> heads-major (b, h|hk, n, dh)."""
+        h, kvh, dh = self.heads, self._kv_heads(), self.dim_head
+        qkv = self.to_qkv(self.prenorm(x))
+        q, k, v = jnp.split(qkv, [h * dh, (h + kvh) * dh], axis=-1)
+        b, n, _ = x.shape
+        q = q.reshape(b, n, h, dh).transpose(0, 2, 1, 3)
+        k = k.reshape(b, n, kvh, dh).transpose(0, 2, 1, 3)
+        v = v.reshape(b, n, kvh, dh).transpose(0, 2, 1, 3)
+        return q, k, v
+
     def __call__(
         self,
         x: jax.Array,
@@ -82,7 +105,6 @@ class RingAttention(nn.Module):
         and constrained onto the ``(data, seq)`` mesh; the inverse is applied
         to the output (ref ``ring_attention.py:389-403,458-464``).
         """
-        h, kvh, dh = self.heads, self._kv_heads(), self.dim_head
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
 
         n_orig = x.shape[1]
@@ -96,14 +118,8 @@ class RingAttention(nn.Module):
                 x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
             )
 
-        normed = RMSNorm(self.dim)(x)
-        qkv = nn.Dense((h + 2 * kvh) * dh, use_bias=False, dtype=self.dtype)(normed)
-        q, k, v = jnp.split(qkv, [h * dh, (h + kvh) * dh], axis=-1)
-
+        q, k, v = self._project_qkv(x)
         b, n, _ = x.shape
-        q = q.reshape(b, n, h, dh).transpose(0, 2, 1, 3)
-        k = k.reshape(b, n, kvh, dh).transpose(0, 2, 1, 3)
-        v = v.reshape(b, n, kvh, dh).transpose(0, 2, 1, 3)
 
         if self.causal:
             mask = None  # ref asserts causal and key-pad mask are exclusive
@@ -113,8 +129,8 @@ class RingAttention(nn.Module):
         else:
             out = self._local_attend(q, k, v, mask)
 
-        out = out.transpose(0, 2, 1, 3).reshape(b, n, h * dh)
-        out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(out)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, self.heads * self.dim_head)
+        out = self.to_out(out)
 
         if ring and self.auto_shard:
             if self.striped:
@@ -207,3 +223,129 @@ class RingAttention(nn.Module):
             # checker; jax suggests check_vma=False as the workaround
             check_vma=not self.use_pallas,
         )(q, k, v, mask)
+
+    # ------------------------------------------------------------------
+    # Incremental decoding (beyond reference parity)
+    # ------------------------------------------------------------------
+
+    def decode_step(
+        self,
+        x: jax.Array,  # (b, 1, dim) — the new token's activation
+        cache_k: jax.Array,  # (b, hk, max_len, dh); sharded over seq if ring
+        cache_v: jax.Array,
+        pos: jax.Array,  # scalar int32: index the new token occupies
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """One token of autoregressive decoding against a KV cache.
+
+        Writes this token's K/V at ``pos`` and attends positions
+        ``[0, pos]`` — restricted to the last ``max_lookback_seq_len``
+        positions when the layer has a lookback window, matching the
+        training-time forward.  With a mesh, the cache is sharded
+        contiguously over the ``seq`` axis and the shard partials merge via
+        tree attention (``parallel/tree_decode.py``); decode layout is
+        always contiguous regardless of how training was striped, since
+        positions are explicit.  Returns ``(out (b,1,dim), cache_k, cache_v)``.
+        """
+        q, k, v = self._project_qkv(x)
+        if self.rotary:
+            freqs = rotary_freqs(
+                jnp.reshape(pos, (1,)), self.dim_head, self.rotary_theta
+            )
+            q = apply_rotary(q, freqs)
+            k = apply_rotary(k, freqs)
+
+        ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
+        if not ring:
+            cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), pos, axis=2)
+            cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), pos, axis=2)
+            kv_mask = self._decode_mask(
+                jnp.arange(cache_k.shape[2]), pos, x.shape[0]
+            )
+            out = default_attention(
+                q, cache_k, cache_v, kv_mask,
+                softclamp_value=self.softclamp_value,
+            )
+        else:
+            out, cache_k, cache_v = self._ring_decode(q, k, v, cache_k, cache_v, pos)
+
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], 1, -1)
+        return self.to_out(out), cache_k, cache_v
+
+    def _decode_mask(self, idx: jax.Array, pos: jax.Array, batch: int) -> jax.Array:
+        """Valid-cache-slot mask for a decode step: ``[0, pos]``, windowed to
+        the last ``max_lookback_seq_len`` tokens when configured."""
+        keep = idx <= pos
+        if self.max_lookback_seq_len is not None:
+            keep = keep & (idx > pos - self.max_lookback_seq_len)
+        return jnp.broadcast_to(keep[None, :], (batch, idx.shape[0]))
+
+    def prefill(
+        self,
+        x: jax.Array,  # (b, n, dim) — the whole prompt
+        cache_k: jax.Array,  # (b, hk, max_len, dh)
+        cache_v: jax.Array,
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """Process a whole prompt in one causal pass and fill cache[0:n].
+
+        One O(n^2) flash pass instead of n decode steps; the written K/V are
+        rotary-applied exactly as ``decode_step`` writes them, so decoding
+        can continue from position ``n``.  Returns
+        ``(out (b,n,dim), cache_k, cache_v)``.
+        """
+        n = x.shape[1]
+        assert n <= cache_k.shape[2], "prompt longer than the cache"
+        q, k, v = self._project_qkv(x)
+        if self.rotary:
+            freqs = rotary_freqs(jnp.arange(n), self.dim_head, self.rotary_theta)
+            q = apply_rotary(q, freqs)
+            k = apply_rotary(k, freqs)
+
+        out = flash_attention(
+            q, k, v, causal=True, bucket_size=self.bucket_size,
+            window=self.max_lookback_seq_len,
+            softclamp_value=self.softclamp_value,
+        )
+        zeros = (0, 0, 0, 0)
+        cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), zeros)
+        cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), zeros)
+
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], n, -1)
+        return self.to_out(out), cache_k, cache_v
+
+    def _ring_decode(self, q, k, v, cache_k, cache_v, pos):
+        ring_size = self._ring_size()
+        n_local = cache_k.shape[2] // ring_size
+
+        def core(q, k, v, cache_k, cache_v, pos):
+            rank = lax.axis_index(SEQ_AXIS)
+            owner = pos // n_local
+            local_pos = pos % n_local
+
+            def write(c, new):
+                return lax.dynamic_update_slice_in_dim(
+                    c, new.astype(c.dtype), local_pos, axis=2
+                )
+
+            cache_k = lax.cond(
+                rank == owner, lambda c: write(c, k), lambda c: c, cache_k
+            )
+            cache_v = lax.cond(
+                rank == owner, lambda c: write(c, v), lambda c: c, cache_v
+            )
+            idx = rank * n_local + jnp.arange(n_local)
+            kv_mask = self._decode_mask(idx, pos, q.shape[0])
+            out = tree_attn_decode(
+                q, cache_k, cache_v, kv_mask,
+                axis_name=SEQ_AXIS,
+                softclamp_value=self.softclamp_value,
+            )
+            return out, cache_k, cache_v
+
+        cspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        rep = P(DATA_AXIS, None, None, None)
+        return jax.shard_map(
+            core,
+            mesh=self.mesh,
+            in_specs=(rep, rep, rep, cspec, cspec, P()),
+            out_specs=(rep, cspec, cspec),
+        )(q, k, v, cache_k, cache_v, pos)

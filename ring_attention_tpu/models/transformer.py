@@ -11,9 +11,16 @@ constraint) and the attention layers run pre-sharded (the reference
 similarly passes ``auto_shard_seq=False`` down to layers,
 ref ``ring_attention.py:565``).  Per-layer ``max_lookback_seq_len`` gives
 local -> global attention over depth (ref ``ring_attention.py:546-561``).
+
+Beyond the reference: an incremental decoding path — ``init_cache`` /
+``decode_step`` / ``generate`` — running tree-attention decoding against a
+ring-sharded KV cache (the reference only ships the standalone collective,
+ref ``tree_attn_decoding.py``).
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax
@@ -50,6 +57,36 @@ class RingTransformer(nn.Module):
     use_pallas: bool = False
     dtype: jnp.dtype | None = None
 
+    def setup(self):
+        self.embed = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype)
+        self.attn_layers = [
+            RingAttention(
+                dim=self.dim,
+                heads=self.heads,
+                dim_head=self.dim_head,
+                kv_heads=self.kv_heads,
+                causal=self.causal,
+                striped=self.striped and self._ring_size() > 1,
+                bucket_size=self.bucket_size,
+                use_ring=self.use_ring,
+                force_regular_attn=self.force_regular_attn,
+                rotary=self.rotary,
+                softclamp_value=self.softclamp_value,
+                max_lookback_seq_len=lookback,
+                auto_shard=False,  # sharded once at model top
+                mesh=self.mesh,
+                use_pallas=self.use_pallas,
+                dtype=self.dtype,
+            )
+            for lookback in self._lookbacks()
+        ]
+        self.ff_layers = [
+            FeedForward(self.dim, self.ff_mult, dtype=self.dtype)
+            for _ in range(self.depth)
+        ]
+        self.final_norm = RMSNorm(self.dim)
+        self.to_logits = nn.Dense(self.num_tokens, use_bias=False, dtype=self.dtype)
+
     def _ring_size(self) -> int:
         if self.mesh is None or not self.use_ring or self.force_regular_attn:
             return 1
@@ -62,7 +99,6 @@ class RingTransformer(nn.Module):
         assert len(lb) == self.depth
         return lb
 
-    @nn.compact
     def __call__(
         self,
         tokens: jax.Array,
@@ -98,38 +134,18 @@ class RingTransformer(nn.Module):
                 if striped:
                     mask = stripe_permute(mask, ring)
 
-        x = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype)(tokens)
+        x = self.embed(tokens)
         if ring > 1 and self.auto_shard:
             x = lax.with_sharding_constraint(
                 x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
             )
 
-        for lookback in self._lookbacks():
-            x = (
-                RingAttention(
-                    dim=self.dim,
-                    heads=self.heads,
-                    dim_head=self.dim_head,
-                    kv_heads=self.kv_heads,
-                    causal=self.causal,
-                    striped=striped,
-                    bucket_size=self.bucket_size,
-                    use_ring=self.use_ring,
-                    force_regular_attn=self.force_regular_attn,
-                    rotary=self.rotary,
-                    softclamp_value=self.softclamp_value,
-                    max_lookback_seq_len=lookback,
-                    auto_shard=False,  # sharded once at model top
-                    mesh=self.mesh,
-                    use_pallas=self.use_pallas,
-                    dtype=self.dtype,
-                )(x, mask)
-                + x
-            )
-            x = FeedForward(self.dim, self.ff_mult, dtype=self.dtype)(x) + x
+        for attn, ff in zip(self.attn_layers, self.ff_layers):
+            x = attn(x, mask) + x
+            x = ff(x) + x
 
-        x = RMSNorm(self.dim)(x)
-        logits = nn.Dense(self.num_tokens, use_bias=False, dtype=self.dtype)(x)
+        x = self.final_norm(x)
+        logits = self.to_logits(x)
 
         if ring > 1 and self.auto_shard:
             if striped:
@@ -145,3 +161,88 @@ class RingTransformer(nn.Module):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
         return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+    # ------------------------------------------------------------------
+    # Incremental decoding
+    # ------------------------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int) -> dict[str, Any]:
+        """Fresh KV cache pytree; ``max_len`` must divide over the ring."""
+        ring = self._ring_size()
+        assert max_len % max(ring, 1) == 0
+        kvh = self.kv_heads or self.heads
+        shape = (batch, kvh, max_len, self.dim_head)
+        dtype = self.dtype or jnp.float32
+        zeros = jnp.zeros(shape, dtype)
+        if ring > 1:
+            sharding = NamedSharding(self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
+            zeros = jax.device_put(zeros, sharding)
+        return {
+            "k": [zeros for _ in range(self.depth)],
+            "v": [zeros for _ in range(self.depth)],
+        }
+
+    def decode_step(
+        self,
+        token: jax.Array,  # (b,) int32 — token at position `pos`
+        cache: dict[str, Any],
+        pos: jax.Array,  # scalar int32
+    ) -> tuple[jax.Array, dict[str, Any]]:
+        """Next-token logits given the token at ``pos`` and the cache of
+        positions ``[0, pos)``.  Returns ``(logits (b, vocab), new_cache)``."""
+        x = self.embed(token[:, None])
+        new_k, new_v = [], []
+        for i, (attn, ff) in enumerate(zip(self.attn_layers, self.ff_layers)):
+            a, ck, cv = attn.decode_step(x, cache["k"][i], cache["v"][i], pos)
+            new_k.append(ck)
+            new_v.append(cv)
+            x = a + x
+            x = ff(x) + x
+        x = self.final_norm(x)
+        logits = self.to_logits(x)[:, 0]
+        return logits, {"k": new_k, "v": new_v}
+
+    def prefill(
+        self,
+        tokens: jax.Array,  # (b, n) int32
+        cache: dict[str, Any],
+    ) -> tuple[jax.Array, dict[str, Any]]:
+        """One causal pass over the prompt, filling cache positions [0, n).
+
+        Returns ``(last_logits (b, vocab), cache)`` — n flash-prefilled
+        positions instead of n sequential decode steps."""
+        x = self.embed(tokens)
+        new_k, new_v = [], []
+        for i, (attn, ff) in enumerate(zip(self.attn_layers, self.ff_layers)):
+            a, ck, cv = attn.prefill(x, cache["k"][i], cache["v"][i])
+            new_k.append(ck)
+            new_v.append(cv)
+            x = a + x
+            x = ff(x) + x
+        x = self.final_norm(x)
+        logits = self.to_logits(x)[:, -1]
+        return logits, {"k": new_k, "v": new_v}
+
+    def generate(
+        self,
+        prompt: jax.Array,  # (b, n) int32
+        max_len: int,
+        num_steps: int,
+    ) -> jax.Array:
+        """Greedy generation: one prefill pass over the prompt, then emit
+        ``num_steps`` new tokens.  Returns ``(b, num_steps)``."""
+        b, n = prompt.shape
+        assert n >= 1, "generate needs a non-empty prompt"
+        assert num_steps >= 1, "generate needs num_steps >= 1"
+        assert n + num_steps - 1 <= max_len, "cache too small for prompt + steps"
+        cache = self.init_cache(b, max_len)
+        logits, cache = self.prefill(prompt, cache)
+        outs = []
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        for j in range(num_steps):
+            outs.append(tok)
+            if j == num_steps - 1:
+                break
+            logits, cache = self.decode_step(tok, cache, jnp.int32(n + j))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jnp.stack(outs, axis=1)

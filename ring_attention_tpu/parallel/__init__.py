@@ -1,3 +1,12 @@
+from .collectives import (
+    all_gather_variable,
+    axis_rank,
+    axis_world,
+    fold_batch_into_seq,
+    gather_sizes,
+    split_by_rank,
+    unfold_seq_into_batch,
+)
 from .mesh import DATA_AXIS, SEQ_AXIS, create_mesh, replicated, seq_sharding
 from .ring import ring_flash_attention
 from .tree_decode import tree_attn_decode
@@ -15,6 +24,13 @@ from .sharding import (
 )
 
 __all__ = [
+    "all_gather_variable",
+    "axis_rank",
+    "axis_world",
+    "fold_batch_into_seq",
+    "gather_sizes",
+    "split_by_rank",
+    "unfold_seq_into_batch",
     "DATA_AXIS",
     "SEQ_AXIS",
     "create_mesh",

@@ -1,0 +1,118 @@
+"""Collective helpers: the reference's L1 ``distributed.py``, TPU-style.
+
+The reference wraps ``torch.distributed`` in rank/world helpers, an
+autograd all-gather, a variable-size gather, and a rank-splitter
+(ref ``distributed.py:31-127``).  On a mesh almost all of that is a JAX
+builtin; this module provides the named analogues so reference users find
+each capability, plus the one genuinely non-trivial piece: a
+**static-shape variable-size gather** (the reference's
+``all_gather_variable_dim``, ref ``distributed.py:58-84``) — XLA needs
+static shapes, so ragged gathers become pad-to-max + per-shard length
+masks, with ``max_size`` fixed at trace time.
+
+| reference (distributed.py)        | here                                   |
+|-----------------------------------|----------------------------------------|
+| ``get_rank`` :31-33               | ``axis_rank(axis)`` (lax.axis_index)   |
+| ``get_world_size`` :35-37         | ``axis_world(axis)`` (lax.axis_size)   |
+| ``is_distributed`` :39-41         | ``jax.device_count() > 1`` / mesh size |
+| ``all_gather_same_dim`` :43-48    | ``lax.all_gather(..., tiled=True)``    |
+| ``gather_sizes`` :50-53           | ``gather_sizes``                       |
+| ``all_gather_variable_dim`` :58-84| ``all_gather_variable``                |
+| ``AllGatherFunction`` bwd :103-107| ``lax.all_gather`` transpose (automatic)|
+| ``split_by_rank`` :117-127        | ``split_by_rank``                      |
+
+The lru-cached topology of the reference (fixed after first call — no
+elastic resize, SURVEY §5) is inherent here: the mesh is part of the
+compiled program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def axis_rank(axis_name: str) -> jax.Array:
+    """This device's position along a mesh axis (inside shard_map)."""
+    return lax.axis_index(axis_name)
+
+
+def axis_world(axis_name: str) -> int:
+    """Static size of a mesh axis (inside shard_map)."""
+    return lax.axis_size(axis_name)
+
+
+def gather_sizes(size: jax.Array, axis_name: str) -> jax.Array:
+    """All shards' sizes, shape ``(world,)`` (ref ``distributed.py:50-53``)."""
+    return lax.all_gather(jnp.asarray(size, jnp.int32), axis_name)
+
+
+def all_gather_variable(
+    x: jax.Array,
+    length: jax.Array,
+    axis_name: str,
+    *,
+    max_size: int | None = None,
+    axis: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Gather shards whose *used* length differs per device.
+
+    ``x`` must be padded to a common static ``max_size`` along ``axis``
+    (defaults to ``x.shape[axis]``); ``length`` is this shard's used length.
+    Returns ``(gathered, mask)`` where ``gathered`` has
+    ``world * max_size`` entries along ``axis`` in rank order and ``mask``
+    is a flat boolean validity mask of shape ``(world * max_size,)``.
+
+    This is the XLA answer to the reference's ragged gather
+    (pad + mask + index_select, ref ``distributed.py:58-84``): same
+    semantics, but shapes are static so the program compiles once.  Use
+    ``compact_masked`` on the host to drop the padding if a dense result
+    is required.
+    """
+    if max_size is None:
+        max_size = x.shape[axis]
+    assert x.shape[axis] == max_size, "pad x to max_size before gathering"
+    world = lax.axis_size(axis_name)
+
+    gathered = lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    lengths = gather_sizes(length, axis_name)  # (world,)
+    slot = jnp.arange(world * max_size) % max_size
+    owner = jnp.arange(world * max_size) // max_size
+    mask = slot < lengths[owner]
+    return gathered, mask
+
+
+def split_by_rank(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
+    """Take this rank's equal slice of a replicated array
+    (ref ``distributed.py:117-127``)."""
+    world = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    size = x.shape[axis] // world
+    return lax.dynamic_slice_in_dim(x, rank * size, size, axis=axis)
+
+
+def fold_batch_into_seq(x: jax.Array, num_sharded_batches: int) -> jax.Array:
+    """Concatenate ``num_sharded_batches`` batch groups along the sequence.
+
+    The reference gathers the batch across the world and folds
+    ``world // (seq / shard)`` extra batches into sequence so a small batch
+    can use a big world (``sharded_batch_to_sharded_seq``,
+    ref ``ring_attention.py:223-262``).  On a mesh the same capacity choice
+    is just the ``(data, seq)`` mesh shape — rings are mesh rows — so this
+    helper is a pure reshape used when converting reference-style inputs:
+    ``(b, n, ...) -> (b / k, k * n, ...)``.
+    """
+    b, n = x.shape[0], x.shape[1]
+    k = num_sharded_batches
+    assert b % k == 0
+    return x.reshape(b // k, k * n, *x.shape[2:])
+
+
+def unfold_seq_into_batch(x: jax.Array, num_sharded_batches: int) -> jax.Array:
+    """Inverse of :func:`fold_batch_into_seq`
+    (ref ``sharded_seq_to_sharded_batch``, ``ring_attention.py:264-279``)."""
+    b, kn = x.shape[0], x.shape[1]
+    k = num_sharded_batches
+    assert kn % k == 0
+    return x.reshape(b * k, kn // k, *x.shape[2:])

@@ -66,15 +66,6 @@ def _chunk_take(x: jax.Array, chunk_order: list[int], chunk: int, axis: int) -> 
     return x.reshape(shape)
 
 
-def zigzag_chunk_starts(ring_size: int, n_global: int) -> jnp.ndarray:
-    """Global start position of each device's two chunks, shape (W, 2)."""
-    chunk = n_global // (2 * ring_size)
-    starts = []
-    for r in range(ring_size):
-        starts.append([r * chunk, (2 * ring_size - 1 - r) * chunk])
-    return jnp.asarray(starts)
-
-
 def zigzag_positions(n_local: int, rank: jax.Array, ring_size: int) -> jax.Array:
     """Global token positions of a zig-zag shard (for rotary / masks).
 
@@ -115,7 +106,6 @@ def zigzag_attention(
     ring_size = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     chunk = n_local // 2
-    n_global = n_local * ring_size
 
     # gather K/V over sequence: (b, hk, n_global, d) in zig-zag shard order
     k_all = lax.all_gather(k, axis_name, axis=2, tiled=True)

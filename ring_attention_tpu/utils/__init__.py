@@ -1,0 +1,4 @@
+from .checkpoint import restore_checkpoint, save_checkpoint
+from .profiling import StepTimer, trace
+
+__all__ = ["restore_checkpoint", "save_checkpoint", "StepTimer", "trace"]

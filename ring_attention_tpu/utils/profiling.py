@@ -1,0 +1,60 @@
+"""Profiling and throughput measurement.
+
+The reference has no timers or profiler hooks at all (SURVEY §5).  On TPU
+the platform profiler (XProf via ``jax.profiler``) is the ground truth for
+MXU utilization and ICI overlap; this module adds the two things a training
+loop actually calls: a trace context and a step-throughput meter.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an XLA/TPU profile viewable in XProf/TensorBoard.
+
+    >>> with trace("/tmp/profile"):
+    ...     step(...)  # traced region
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock throughput meter for a training/decoding loop.
+
+    Blocks on the supplied result each step so async dispatch doesn't hide
+    device time; reports steps/s and tokens/s over a sliding window.
+    """
+
+    tokens_per_step: int = 0
+    window: int = 20
+    _times: list = field(default_factory=list)
+
+    def step(self, result=None) -> None:
+        if result is not None:
+            jax.block_until_ready(result)
+        self._times.append(time.perf_counter())
+        if len(self._times) > self.window + 1:
+            self._times.pop(0)
+
+    @property
+    def steps_per_sec(self) -> float:
+        if len(self._times) < 2:
+            return 0.0
+        span = self._times[-1] - self._times[0]
+        return (len(self._times) - 1) / span if span > 0 else 0.0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.steps_per_sec * self.tokens_per_step

@@ -1,0 +1,141 @@
+"""Parity: incremental KV-cache decoding vs the full causal forward.
+
+Extends the reference's decode story (standalone ``tree_attn_decode``,
+``assert_tree_attn.py``) to the model level: feeding tokens one at a time
+through ``decode_step`` against a (ring-sharded) KV cache must reproduce
+the full-sequence causal forward logits at every step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ring_attention_tpu.models import RingTransformer
+from ring_attention_tpu.parallel import create_mesh
+
+ATOL = 3e-5
+VOCAB = 128
+
+
+def _decode_all(model, params, tokens, max_len):
+    """Run decode_step over each token; stack per-step logits."""
+    b, n = tokens.shape
+    cache = model.apply(params, b, max_len, method=RingTransformer.init_cache)
+    outs = []
+    for i in range(n):
+        logits, cache = model.apply(
+            params, tokens[:, i], cache, jnp.int32(i),
+            method=RingTransformer.decode_step,
+        )
+        outs.append(logits)
+    return jnp.stack(outs, axis=1)  # (b, n, vocab)
+
+
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_decode_matches_forward_local(rng, kv_heads):
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False, kv_heads=kv_heads,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    full = model.apply(params, tokens)
+    inc = _decode_all(model, params, tokens, max_len=16)
+    np.testing.assert_allclose(inc, full, atol=ATOL)
+
+
+def test_decode_matches_forward_ring(rng):
+    """Cache sharded over an 8-ring; tree-attention merge per step."""
+    mesh = create_mesh(ring_size=8)
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, mesh=mesh,
+    )
+    ref_model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    full = ref_model.apply(params, tokens)
+    inc = _decode_all(model, params, tokens, max_len=16)
+    np.testing.assert_allclose(inc, full, atol=ATOL)
+
+
+def test_generate_greedy(rng):
+    """generate() returns the same tokens as greedy decoding over the
+    full-forward logits."""
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+    )
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    gen = model.apply(
+        params, prompt, 32, 4, method=RingTransformer.generate
+    )
+    assert gen.shape == (2, 4)
+
+    # oracle: repeatedly run the full forward and take argmax
+    seq = prompt
+    expect = []
+    for _ in range(4):
+        logits = model.apply(params, seq)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        expect.append(tok)
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(gen, jnp.stack(expect, axis=1))
+
+
+def test_decode_with_lookback(rng):
+    """Layers with lookback windows must decode identically to the forward
+    (regression: decode_step ignoring max_lookback_seq_len)."""
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False, max_lookback_seq_len=4,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    full = model.apply(params, tokens)
+    inc = _decode_all(model, params, tokens, max_len=16)
+    np.testing.assert_allclose(inc, full, atol=ATOL)
+
+
+def test_prefill_then_decode(rng):
+    """One prefill pass + decode steps == token-by-token decoding."""
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 10)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    full = model.apply(params, tokens)
+
+    cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
+    logits, cache = model.apply(
+        params, tokens[:, :8], cache, method=RingTransformer.prefill
+    )
+    np.testing.assert_allclose(logits, full[:, 7], atol=ATOL)
+    # continue decoding from position 8
+    for i in (8, 9):
+        logits, cache = model.apply(
+            params, tokens[:, i], cache, jnp.int32(i),
+            method=RingTransformer.decode_step,
+        )
+        np.testing.assert_allclose(logits, full[:, i], atol=ATOL)
+
+
+def test_generate_edge_asserts(rng):
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=16, depth=1, heads=2, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+    )
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    with pytest.raises(AssertionError):
+        model.apply(params, prompt[:, :0], 16, 2, method=RingTransformer.generate)
+    with pytest.raises(AssertionError):
+        model.apply(params, prompt, 16, 0, method=RingTransformer.generate)
+    with pytest.raises(AssertionError):
+        model.apply(params, prompt, 4, 4, method=RingTransformer.generate)

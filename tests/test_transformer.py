@@ -67,8 +67,8 @@ def test_loss_and_embedding_grads(rng, mesh):
 
     g_ref = jax.grad(lambda p: loss(ref_model, p))(params)
     g_ring = jax.grad(lambda p: loss(ring_model, p))(params)
-    emb_ref = g_ref["params"]["Embed_0"]["embedding"]
-    emb_ring = g_ring["params"]["Embed_0"]["embedding"]
+    emb_ref = g_ref["params"]["embed"]["embedding"]
+    emb_ring = g_ring["params"]["embed"]["embedding"]
     np.testing.assert_allclose(emb_ring, emb_ref, atol=GRAD_ATOL)
 
 
@@ -137,3 +137,19 @@ def test_pallas_transformer_parity(rng, mesh):
         ring_model.apply(params, tokens), ref_model.apply(params, tokens),
         atol=ATOL,
     )
+
+
+def test_bf16_training_path(rng, mesh):
+    """bf16 activations end-to-end: loss finite and grads flow."""
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=1, heads=4, dim_head=8,
+        causal=True, striped=True, bucket_size=8, mesh=mesh,
+        dtype=jnp.bfloat16,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 65)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    loss, grads = jax.value_and_grad(
+        lambda p: model.apply(p, tokens, return_loss=True)
+    )(params)
+    assert bool(jnp.isfinite(loss))
+    assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
