@@ -27,6 +27,8 @@ def main() -> None:
     ap.add_argument("--depth", type=int, default=2)
     ap.add_argument("--ring-size", type=int, default=None)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize blocks (activation memory savings)")
     ap.add_argument("--use-pallas", action="store_true",
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     args = ap.parse_args()
@@ -64,6 +66,7 @@ def main() -> None:
         mesh=mesh,
         use_ring=mesh is not None,
         use_pallas=args.use_pallas,
+        remat=args.remat,
         dtype=jnp.bfloat16 if args.bf16 else None,
     )
 

@@ -287,9 +287,12 @@ class RingAttention(nn.Module):
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
         """Process a whole prompt in one causal pass and fill cache[0:n].
 
-        One O(n^2) flash pass instead of n decode steps; the written K/V are
-        rotary-applied exactly as ``decode_step`` writes them, so decoding
-        can continue from position ``n``.  Returns
+        One O(n^2)-FLOPs flash pass instead of n decode steps; the written
+        K/V are rotary-applied exactly as ``decode_step`` writes them, so
+        decoding can continue from position ``n``.  With a mesh, the prompt
+        is padded onto the ring and attention runs sequence-parallel
+        (contiguous layout, like the decode cache) — per-device memory
+        scales as n/ring, same as the training forward.  Returns
         ``(out (b,n,dim), cache_k, cache_v)``.
         """
         n = x.shape[1]
@@ -300,17 +303,67 @@ class RingAttention(nn.Module):
             q = apply_rotary(q, freqs)
             k = apply_rotary(k, freqs)
 
-        out = flash_attention(
-            q, k, v, causal=True, bucket_size=self.bucket_size,
-            window=self.max_lookback_seq_len,
-            softclamp_value=self.softclamp_value,
-        )
+        ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
+        if ring:
+            out = self._ring_prefill_attend(q, k, v)
+        else:
+            out = flash_attention(
+                q, k, v, causal=True, bucket_size=self.bucket_size,
+                window=self.max_lookback_seq_len,
+                softclamp_value=self.softclamp_value,
+            )
         zeros = (0, 0, 0, 0)
         cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), zeros)
         cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), zeros)
 
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], n, -1)
         return self.to_out(out), cache_k, cache_v
+
+    def _ring_prefill_attend(self, q, k, v):
+        """Ring attention over the prompt in contiguous (cache) layout.
+
+        Rotary is already applied (global positions), so the shard_map core
+        calls the ring collective directly; right-padding to the ring size
+        is invisible under causal masking (pad keys sit after every real
+        query) and padded output rows are sliced off.
+        """
+        ring_size = self._ring_size()
+        n = q.shape[2]
+        pad = (-n) % ring_size
+        if pad:
+            widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
+            q = jnp.pad(q, widths)
+            k = jnp.pad(k, widths)
+            v = jnp.pad(v, widths)
+        n_local = (n + pad) // ring_size
+        bucket = max(min(self.bucket_size, n_local), 1)
+        while n_local % bucket:
+            bucket -= 1
+
+        max_ring_passes = None
+        window = None
+        if self.max_lookback_seq_len is not None:
+            window = self.max_lookback_seq_len
+            max_ring_passes = math.ceil((window - 1) / n_local) + 1
+
+        def core(q, k, v):
+            return ring_flash_attention(
+                q, k, v, None, SEQ_AXIS,
+                True, False,  # causal, contiguous (non-striped) layout
+                bucket, max_ring_passes, window,
+                self.softclamp_value, None,
+                "pallas" if self.use_pallas else "xla",
+            )
+
+        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        out = jax.shard_map(
+            core,
+            mesh=self.mesh,
+            in_specs=(qspec, qspec, qspec),
+            out_specs=qspec,
+            check_vma=not self.use_pallas,
+        )(q, k, v)
+        return out[:, :, :n]
 
     def _ring_decode(self, q, k, v, cache_k, cache_v, pos):
         ring_size = self._ring_size()

@@ -55,12 +55,22 @@ class RingTransformer(nn.Module):
     auto_shard: bool = True
     mesh: Mesh | None = None
     use_pallas: bool = False
+    # rematerialize each block in backward: trades recompute for activation
+    # memory — the standard recipe for quarter-million-token training.
+    # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
+    # shard_map has no eager path)
+    remat: bool = False
     dtype: jnp.dtype | None = None
 
     def setup(self):
         self.embed = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype)
+        # flax-lifted remat (NOT raw jax.checkpoint: param creation during
+        # init is a side effect that would leak tracers out of the
+        # checkpointed trace)
+        attn_cls = nn.remat(RingAttention) if self.remat else RingAttention
+        ff_cls = nn.remat(FeedForward) if self.remat else FeedForward
         self.attn_layers = [
-            RingAttention(
+            attn_cls(
                 dim=self.dim,
                 heads=self.heads,
                 dim_head=self.dim_head,
@@ -81,7 +91,7 @@ class RingTransformer(nn.Module):
             for lookback in self._lookbacks()
         ]
         self.ff_layers = [
-            FeedForward(self.dim, self.ff_mult, dtype=self.dtype)
+            ff_cls(self.dim, self.ff_mult, dtype=self.dtype)
             for _ in range(self.depth)
         ]
         self.final_norm = RMSNorm(self.dim)

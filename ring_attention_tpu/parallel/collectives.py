@@ -88,6 +88,10 @@ def split_by_rank(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
     (ref ``distributed.py:117-127``)."""
     world = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
+    assert x.shape[axis] % world == 0, (
+        f"axis {axis} size {x.shape[axis]} must divide over {world} ranks; "
+        "pad first (pad_to_multiple)"
+    )
     size = x.shape[axis] // world
     return lax.dynamic_slice_in_dim(x, rank * size, size, axis=axis)
 

@@ -22,11 +22,13 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_checkpoint(path: str | os.PathLike, state: Any, *, force: bool = True) -> None:
+def save_checkpoint(path: str | os.PathLike, state: Any, *, force: bool = False) -> None:
     """Write a pytree (params / optimizer state / step counter) to ``path``.
 
     Arrays keep their shardings; call from every process in a multi-host
-    setup (orbax coordinates the write).
+    setup (orbax coordinates the write).  Refuses to overwrite an existing
+    checkpoint unless ``force=True`` (orbax's safe default) — use distinct
+    step-numbered paths for periodic saves.
     """
     ckptr = _checkpointer()
     ckptr.save(os.fspath(os.path.abspath(path)), state, force=force)

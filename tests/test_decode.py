@@ -139,3 +139,32 @@ def test_generate_edge_asserts(rng):
         model.apply(params, prompt, 16, 0, method=RingTransformer.generate)
     with pytest.raises(AssertionError):
         model.apply(params, prompt, 4, 4, method=RingTransformer.generate)
+
+
+def test_ring_prefill_then_decode(rng):
+    """Ring-sharded prefill (sequence-parallel prompt pass) + tree-decode
+    steps == the unsharded causal forward."""
+    mesh = create_mesh(ring_size=8)
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, mesh=mesh,
+    )
+    ref_model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 11)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    full = ref_model.apply(params, tokens)
+
+    cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
+    logits, cache = model.apply(
+        params, tokens[:, :9], cache, method=RingTransformer.prefill
+    )
+    np.testing.assert_allclose(logits, full[:, 8], atol=ATOL)
+    for i in (9, 10):
+        logits, cache = model.apply(
+            params, tokens[:, i], cache, jnp.int32(i),
+            method=RingTransformer.decode_step,
+        )
+        np.testing.assert_allclose(logits, full[:, i], atol=ATOL)

@@ -1,0 +1,180 @@
+"""Cross-framework parity: this package vs the original PyTorch reference.
+
+Runs the actual reference implementation (mounted read-only at
+``/root/reference``, torch CPU) on identical inputs and asserts numerical
+agreement with our JAX ops — function-level (no weights involved):
+``default_attention`` and single-process ``ring_flash_attn`` vs our oracle
+and blockwise flash, including causal, GQA, softclamp and key-pad masks.
+
+Skipped automatically when the reference checkout isn't present.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REFERENCE = "/root/reference"
+
+
+def _import_reference():
+    """Import the reference with a no-op beartype stub (not installed here)."""
+    if "beartype" not in sys.modules:
+        stub = types.ModuleType("beartype")
+        stub.beartype = lambda fn=None, **kw: fn if fn is not None else (lambda f: f)
+        sys.modules["beartype"] = stub
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    import ring_attention_pytorch.ring_attention as ref_attn
+    import ring_attention_pytorch.ring_flash_attention as ref_flash
+
+    return ref_attn, ref_flash
+
+
+torch = pytest.importorskip("torch")
+pytest.importorskip("einops")
+
+try:
+    ref_attn, ref_flash = _import_reference()
+    HAVE_REF = True
+except Exception:  # pragma: no cover - reference not mounted
+    HAVE_REF = False
+
+pytestmark = pytest.mark.skipif(not HAVE_REF, reason="reference not available")
+
+ATOL = 2e-5
+
+
+def make_inputs(rng, b=2, h=4, hk=None, n=48, d=16):
+    hk = hk or h
+    q = rng.standard_normal((b, h, n, d)).astype(np.float32)
+    k = rng.standard_normal((b, hk, n, d)).astype(np.float32)
+    v = rng.standard_normal((b, hk, n, d)).astype(np.float32)
+    return q, k, v
+
+
+def ours_default(q, k, v, mask=None, **kw):
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops import default_attention
+
+    out = default_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(mask) if mask is not None else None, **kw
+    )
+    return np.asarray(out)
+
+
+def ours_flash(q, k, v, mask=None, **kw):
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops import flash_attention
+
+    out = flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(mask) if mask is not None else None, bucket_size=16, **kw
+    )
+    return np.asarray(out)
+
+
+def ref_default(q, k, v, mask=None, causal=False, softclamp_value=None):
+    """Adapter: reference uses (b, n, h, d) layout and a softclamp flag."""
+    out = ref_attn.default_attention(
+        torch.from_numpy(q).transpose(1, 2),
+        torch.from_numpy(k).transpose(1, 2),
+        torch.from_numpy(v).transpose(1, 2),
+        mask=torch.from_numpy(mask) if mask is not None else None,
+        causal=causal,
+        softclamp_qk_sim=softclamp_value is not None,
+        softclamp_value=softclamp_value or 50.0,
+    )
+    return out.transpose(1, 2).numpy()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_default_attention_matches_reference(rng, causal):
+    q, k, v = make_inputs(rng)
+    theirs = ref_default(q, k, v, causal=causal)
+    np.testing.assert_allclose(ours_default(q, k, v, causal=causal), theirs, atol=ATOL)
+    np.testing.assert_allclose(ours_flash(q, k, v, causal=causal), theirs, atol=ATOL)
+
+
+def test_gqa_matches_reference(rng):
+    """GQA parity, accounting for a deliberate convention difference: the
+    reference's ``(g h)`` repeat pairs query head j with kv head ``j % hk``
+    (interleaved, ref ring_attention.py:68), while we use the Llama/HF
+    convention ``j // g`` (contiguous blocks).  Permuting query heads maps
+    one onto the other exactly."""
+    h, hk = 4, 2
+    g = h // hk
+    q, k, v = make_inputs(rng, h=h, hk=hk)
+    # our head j pairs kv j // g; reference head i pairs kv i % hk.
+    # feed the reference q' with q'[i] = q[perm[i]], perm[i] = (i % hk) * g + i // hk
+    perm = np.asarray([(i % hk) * g + i // hk for i in range(h)])
+    theirs = ref_default(q[:, perm], k, v, causal=True)
+    ours = ours_flash(q, k, v, causal=True)
+    # reference output head i corresponds to our head perm[i]
+    np.testing.assert_allclose(ours[:, perm], theirs, atol=ATOL)
+
+
+def test_softclamp_matches_reference(rng):
+    q, k, v = make_inputs(rng)
+    theirs = ref_default(q, k, v, causal=True, softclamp_value=5.0)
+    np.testing.assert_allclose(
+        ours_flash(q, k, v, causal=True, softclamp_value=5.0), theirs, atol=ATOL
+    )
+
+
+def test_key_padding_matches_reference(rng):
+    q, k, v = make_inputs(rng)
+    mask = rng.random((2, 48)) > 0.3
+    theirs = ref_default(q, k, v, mask=mask)
+    np.testing.assert_allclose(ours_flash(q, k, v, mask), theirs, atol=ATOL)
+
+
+def test_ring_flash_single_process_matches_reference(rng):
+    """The reference's ring_flash_attn with ring off (1 process) is its
+    blockwise flash path (assert_flash.py pattern); ours must agree."""
+    q, k, v = make_inputs(rng)
+    theirs = ref_flash.ring_flash_attn(
+        torch.from_numpy(q).transpose(1, 2),  # reference uses (b, n, h, d)
+        torch.from_numpy(k).transpose(1, 2),
+        torch.from_numpy(v).transpose(1, 2),
+        causal=True,
+        bucket_size=16,
+        ring_reduce_col=False,
+    ).transpose(1, 2).numpy()
+    np.testing.assert_allclose(ours_flash(q, k, v, causal=True), theirs, atol=ATOL)
+
+
+def test_grads_match_reference(rng):
+    """dq/dk/dv parity with the reference's autograd through its flash path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops import flash_attention
+
+    q, k, v = make_inputs(rng, n=32)
+
+    tq = torch.from_numpy(q.copy()).transpose(1, 2).requires_grad_(True)
+    tk = torch.from_numpy(k.copy()).transpose(1, 2).requires_grad_(True)
+    tv = torch.from_numpy(v.copy()).transpose(1, 2).requires_grad_(True)
+    out = ref_flash.ring_flash_attn(tq, tk, tv, causal=True, bucket_size=16,
+                                    ring_reduce_col=False)
+    (out ** 2).sum().backward()
+
+    g = jax.grad(
+        lambda q, k, v: (
+            flash_attention(q, k, v, causal=True, bucket_size=16) ** 2
+        ).sum(),
+        (0, 1, 2),
+    )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+    for ours, theirs, name in zip(
+        g, (tq.grad, tk.grad, tv.grad), "qkv"
+    ):
+        np.testing.assert_allclose(
+            np.asarray(ours), theirs.transpose(1, 2).numpy(), atol=5e-4,
+            err_msg=f"d{name}",
+        )

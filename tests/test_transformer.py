@@ -153,3 +153,19 @@ def test_bf16_training_path(rng, mesh):
     )(params)
     assert bool(jnp.isfinite(loss))
     assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+
+
+def test_remat_parity(rng, mesh):
+    """remat=True must not change values (only memory/recompute)."""
+    common = dict(num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+                  bucket_size=4, causal=True, striped=True, mesh=mesh)
+    m1 = RingTransformer(**common)
+    m2 = RingTransformer(remat=True, **common)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 64)), jnp.int32)
+    params = m1.init(jax.random.PRNGKey(0), tokens)
+    # remat + shard_map requires jit (as any real train step is)
+    l1, g1 = jax.jit(jax.value_and_grad(lambda p: m1.apply(p, tokens, return_loss=True)))(params)
+    l2, g2 = jax.jit(jax.value_and_grad(lambda p: m2.apply(p, tokens, return_loss=True)))(params)
+    np.testing.assert_allclose(l1, l2, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
