@@ -59,7 +59,9 @@ def _worker(impl: str, seq_len: int) -> None:
         from ring_attention_tpu.ops.flash import flash_attention
 
         bucket = min(1024, seq_len)
-        fn = jax.jit(partial(flash_attention, causal=True, bucket_size=bucket))
+        qc = 2048 if seq_len > 2048 else None  # two-level blocking for memory
+        fn = jax.jit(partial(flash_attention, causal=True, bucket_size=bucket,
+                             q_chunk_size=qc))
 
     out = fn(q, k, v)
     jax.block_until_ready(out)
@@ -98,8 +100,8 @@ def main() -> None:
         ("pallas", TARGET_SEQ, 1500),
         ("pallas", 65536, 900),
         ("pallas", 16384, 600),
-        ("xla", 16384, 900),
-        ("xla", 4096, 600),
+        ("xla", 65536, 900),
+        ("xla", 8192, 600),
     ]
     errors = []
     for impl, seq, budget in attempts:

@@ -29,6 +29,7 @@ from .parallel import (
     stripe_permute,
     stripe_unpermute,
     tree_attn_decode,
+    ulysses_attention,
     zigzag_attention,
     zigzag_permute,
     zigzag_positions,
@@ -57,6 +58,7 @@ __all__ = [
     "stripe_permute",
     "stripe_unpermute",
     "tree_attn_decode",
+    "ulysses_attention",
     "zigzag_attention",
     "zigzag_permute",
     "zigzag_positions",

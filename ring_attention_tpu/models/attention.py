@@ -35,6 +35,8 @@ from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.ring import ring_flash_attention
 from ..parallel.sharding import pad_seq_and_mask, stripe_permute, stripe_unpermute
 from ..parallel.tree_decode import tree_attn_decode
+from ..parallel.ulysses import ulysses_attention
+from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
 from .layers import RMSNorm
 
 
@@ -62,6 +64,11 @@ class RingAttention(nn.Module):
     auto_shard: bool = False
     mesh: Mesh | None = None
     use_pallas: bool = False
+    # context-parallel scheme over the seq mesh axis:
+    #   "ring"    — KV rotation (+ striped load balance); the reference's core
+    #   "zigzag"  — Llama-3 chunk pairing + all-gathered KV (causal only)
+    #   "ulysses" — all-to-all head parallelism (not in the reference)
+    sequence_parallel: str = "ring"
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -106,14 +113,25 @@ class RingAttention(nn.Module):
         to the output (ref ``ring_attention.py:389-403,458-464``).
         """
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
+        assert self.sequence_parallel in ("ring", "zigzag", "ulysses")
+        if self.sequence_parallel == "zigzag":
+            assert self.causal, "zig-zag CP is causal-only (ref zig_zag_attention.py:102-103)"
+            assert self.max_lookback_seq_len is None, "lookback not supported with zigzag"
 
         n_orig = x.shape[1]
         if ring and self.auto_shard:
-            x, mask, n_orig = pad_seq_and_mask(x, mask, self._ring_size())
-            if self.striped:
+            pad_mult = (
+                2 * self._ring_size()
+                if self.sequence_parallel == "zigzag"
+                else self._ring_size()
+            )
+            x, mask, n_orig = pad_seq_and_mask(x, mask, pad_mult)
+            if self.sequence_parallel == "ring" and self.striped:
                 x = stripe_permute(x, self._ring_size())
                 if mask is not None:
                     mask = stripe_permute(mask, self._ring_size())
+            elif self.sequence_parallel == "zigzag":
+                x = zigzag_permute(x, self._ring_size())
             x = lax.with_sharding_constraint(
                 x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
             )
@@ -125,7 +143,7 @@ class RingAttention(nn.Module):
             mask = None  # ref asserts causal and key-pad mask are exclusive
 
         if ring:
-            out = self._ring_attend(q, k, v, mask)
+            out = self._sp_attend(q, k, v, mask)
         else:
             out = self._local_attend(q, k, v, mask)
 
@@ -133,8 +151,10 @@ class RingAttention(nn.Module):
         out = self.to_out(out)
 
         if ring and self.auto_shard:
-            if self.striped:
+            if self.sequence_parallel == "ring" and self.striped:
                 out = stripe_unpermute(out, self._ring_size())
+            elif self.sequence_parallel == "zigzag":
+                out = zigzag_unpermute(out, self._ring_size())
             out = out[:, :n_orig]
         return out
 
@@ -160,13 +180,78 @@ class RingAttention(nn.Module):
             window=window, softclamp_value=self.softclamp_value,
         )
 
+    def _sp_attend(self, q, k, v, mask):
+        """Dispatch to the configured context-parallel scheme."""
+        ring_size = self._ring_size()
+        n = q.shape[2]
+        mult = 2 * ring_size if self.sequence_parallel == "zigzag" else ring_size
+        assert n % mult == 0, (
+            f"sequence {n} must divide over {mult} ({self.sequence_parallel}); "
+            "use auto_shard=True to pad"
+        )
+        if self.sequence_parallel == "zigzag":
+            return self._zigzag_attend(q, k, v)
+        if self.sequence_parallel == "ulysses":
+            return self._ulysses_attend(q, k, v, mask)
+        return self._ring_attend(q, k, v, mask)
+
+    def _zigzag_attend(self, q, k, v):
+        ring_size = self._ring_size()
+        n_local = q.shape[2] // ring_size
+
+        def core(q, k, v):
+            if self.rotary:
+                rank = lax.axis_index(SEQ_AXIS)
+                pos = zigzag_positions(n_local, rank, ring_size)
+                freqs = rotary_freqs(pos, self.dim_head, self.rotary_theta)
+                q = apply_rotary(q, freqs)
+                k = apply_rotary(k, freqs)
+            return zigzag_attention(
+                q, k, v, SEQ_AXIS,
+                bucket_size=self.bucket_size,
+                softclamp_value=self.softclamp_value,
+                impl="pallas" if self.use_pallas else "xla",
+            )
+
+        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        return jax.shard_map(
+            core, mesh=self.mesh,
+            in_specs=(qspec, qspec, qspec), out_specs=qspec,
+            check_vma=not self.use_pallas,
+        )(q, k, v)
+
+    def _ulysses_attend(self, q, k, v, mask):
+        ring_size = self._ring_size()
+        n_local = q.shape[2] // ring_size
+
+        def core(q, k, v, mask):
+            if self.rotary:
+                rank = lax.axis_index(SEQ_AXIS)
+                pos = ring_positions(n_local, rank, striped=False, world=ring_size)
+                freqs = rotary_freqs(pos, self.dim_head, self.rotary_theta)
+                q = apply_rotary(q, freqs)
+                k = apply_rotary(k, freqs)
+            return ulysses_attention(
+                q, k, v, SEQ_AXIS,
+                causal=self.causal,
+                kv_mask=mask,
+                bucket_size=self.bucket_size,
+                window=self.max_lookback_seq_len,
+                softclamp_value=self.softclamp_value,
+                impl="pallas" if self.use_pallas else "xla",
+            )
+
+        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        mspec = P(DATA_AXIS, SEQ_AXIS) if mask is not None else P()
+        return jax.shard_map(
+            core, mesh=self.mesh,
+            in_specs=(qspec, qspec, qspec, mspec), out_specs=qspec,
+            check_vma=not self.use_pallas,
+        )(q, k, v, mask)
+
     def _ring_attend(self, q, k, v, mask):
         ring_size = self._ring_size()
         n = q.shape[2]
-        assert n % ring_size == 0, (
-            f"sequence {n} must divide over ring {ring_size}; "
-            "use auto_shard=True to pad"
-        )
         n_local = n // ring_size
         # per-hop flash tile: largest divisor of the local shard <= bucket_size
         bucket = min(self.bucket_size, n_local)

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.sharding import pad_to_multiple, stripe_permute, stripe_unpermute
+from ..parallel.zigzag import zigzag_permute, zigzag_unpermute
 from .attention import RingAttention
 from .layers import FeedForward, RMSNorm
 
@@ -55,6 +56,7 @@ class RingTransformer(nn.Module):
     auto_shard: bool = True
     mesh: Mesh | None = None
     use_pallas: bool = False
+    sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
     # rematerialize each block in backward: trades recompute for activation
     # memory — the standard recipe for quarter-million-token training.
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
@@ -86,6 +88,7 @@ class RingTransformer(nn.Module):
                 auto_shard=False,  # sharded once at model top
                 mesh=self.mesh,
                 use_pallas=self.use_pallas,
+                sequence_parallel=self.sequence_parallel,
                 dtype=self.dtype,
             )
             for lookback in self._lookbacks()
@@ -122,10 +125,14 @@ class RingTransformer(nn.Module):
 
         ring = self._ring_size()
         n_orig = tokens.shape[1]
-        striped = self.striped and ring > 1
+        striped = self.striped and ring > 1 and self.sequence_parallel == "ring"
+        zigzag = self.sequence_parallel == "zigzag" and ring > 1
+        if zigzag:
+            assert self.causal, "zig-zag CP is causal-only"
 
         if ring > 1 and self.auto_shard:
-            tokens, _ = pad_to_multiple(tokens, ring)
+            pad_mult = 2 * ring if zigzag else ring
+            tokens, _ = pad_to_multiple(tokens, pad_mult)
             padded = tokens.shape[1] != n_orig
             if padded and mask is None and not self.causal:
                 # non-causal: real tokens must not attend to the pad slots,
@@ -136,13 +143,17 @@ class RingTransformer(nn.Module):
                 mask = jnp.broadcast_to(mask, tokens.shape)
             if striped:
                 tokens = stripe_permute(tokens, ring)
+            elif zigzag:
+                tokens = zigzag_permute(tokens, ring)
             tokens = lax.with_sharding_constraint(
                 tokens, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS))
             )
             if mask is not None:
-                mask, _ = pad_to_multiple(mask, ring, value=False)
+                mask, _ = pad_to_multiple(mask, pad_mult, value=False)
                 if striped:
                     mask = stripe_permute(mask, ring)
+                elif zigzag:
+                    mask = zigzag_permute(mask, ring)
 
         x = self.embed(tokens)
         if ring > 1 and self.auto_shard:
@@ -160,6 +171,8 @@ class RingTransformer(nn.Module):
         if ring > 1 and self.auto_shard:
             if striped:
                 logits = stripe_unpermute(logits, ring)
+            elif zigzag:
+                logits = zigzag_unpermute(logits, ring)
             logits = logits[:, :n_orig]
 
         if not return_loss:

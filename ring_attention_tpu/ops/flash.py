@@ -226,7 +226,7 @@ def finalize(carry: FlashCarry) -> tuple[jax.Array, jax.Array]:
 def _flash_fwd_impl(q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value):
     b, h, nq, d = q.shape
     hk = k.shape[1]
-    carry = init_carry(b, hk, h // hk, nq, d)
+    carry = init_carry(b, hk, h // hk, nq, d, like=q)
     carry = attend_blocks(
         q, k, v, carry,
         scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
@@ -352,6 +352,7 @@ def flash_attention(
     window: int | None = None,
     softclamp_value: float | None = None,
     scale: float | None = None,
+    q_chunk_size: int | None = None,
 ) -> jax.Array:
     """Single-device exact flash attention (GQA-aware), differentiable.
 
@@ -361,6 +362,12 @@ def flash_attention(
     slots (pad/slice sit outside the custom_vjp core, so dk/dv slice back
     automatically).  The causal band is end-aligned (``offset = nk - nq``),
     so decode-style ``nq < nk`` calls match the oracle.
+
+    ``q_chunk_size`` additionally tiles the query dimension (two-level
+    blocking): per-step score memory becomes ``q_chunk x bucket`` instead of
+    ``nq x bucket`` — required for very long sequences on the XLA path (the
+    Pallas kernels tile both dimensions natively).  Gradients of the shared
+    K/V sum across chunks through autodiff.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -369,6 +376,30 @@ def flash_attention(
     if causal:
         mask = None  # reference asserts causal and key-pad mask are exclusive
     causal_offset = k.shape[2] - q.shape[2] if causal else None
+
+    nq = q.shape[2]
+    if q_chunk_size is not None and nq > q_chunk_size:
+        outs = []
+        for start in range(0, nq, q_chunk_size):
+            stop = min(start + q_chunk_size, nq)  # ragged tail chunk is fine
+            qc = lax.slice_in_dim(q, start, stop, axis=2)
+            # chunk rows start at `start`, shifting the end-aligned band
+            off_c = causal_offset + start if causal else None
+            outs.append(
+                _flash_with_padding(
+                    qc, k, v, mask, scale, bucket_size, off_c, window,
+                    softclamp_value,
+                )
+            )
+        return jnp.concatenate(outs, axis=2)
+    return _flash_with_padding(
+        q, k, v, mask, scale, bucket_size, causal_offset, window,
+        softclamp_value,
+    )
+
+
+def _flash_with_padding(q, k, v, mask, scale, bucket_size, causal_offset,
+                        window, softclamp_value):
 
     nk = k.shape[2]
     if bucket_size is not None and nk % bucket_size != 0:

@@ -7,9 +7,17 @@ from .collectives import (
     split_by_rank,
     unfold_seq_into_batch,
 )
-from .mesh import DATA_AXIS, SEQ_AXIS, create_mesh, replicated, seq_sharding
+from .mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    create_mesh,
+    initialize_multihost,
+    replicated,
+    seq_sharding,
+)
 from .ring import ring_flash_attention
 from .tree_decode import tree_attn_decode
+from .ulysses import ulysses_attention
 from .zigzag import (
     zigzag_attention,
     zigzag_permute,
@@ -34,10 +42,12 @@ __all__ = [
     "DATA_AXIS",
     "SEQ_AXIS",
     "create_mesh",
+    "initialize_multihost",
     "replicated",
     "seq_sharding",
     "ring_flash_attention",
     "tree_attn_decode",
+    "ulysses_attention",
     "zigzag_attention",
     "zigzag_permute",
     "zigzag_positions",

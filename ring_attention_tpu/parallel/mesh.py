@@ -30,8 +30,15 @@ def create_mesh(
     ``ring_size`` defaults to all devices (one big ring); ``data_size``
     defaults to ``n_devices // ring_size`` — the reference's
     ``num_sharded_batches`` derivation (ref ``ring_attention.py:636-638``).
+
+    On real TPU topologies the device order comes from
+    ``mesh_utils.create_device_mesh`` so the ``seq`` (ring) axis maps onto
+    physically adjacent ICI links — the per-hop ppermute then never crosses
+    DCN.  This replaces the reference's flat-rank assumption (its NCCL ring
+    order is whatever the launcher provided).
     """
-    devices = devices if devices is not None else jax.devices()
+    explicit = devices is not None
+    devices = devices if explicit else jax.devices()
     n = len(devices)
     if ring_size is None:
         ring_size = n if data_size is None else n // data_size
@@ -40,8 +47,35 @@ def create_mesh(
     assert data_size * ring_size == n, (
         f"mesh {data_size}x{ring_size} != {n} devices"
     )
+    if not explicit and devices and devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh((data_size, ring_size))
+            return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+        except (ValueError, NotImplementedError) as e:
+            import warnings
+
+            warnings.warn(
+                f"topology-aware device mesh unavailable ({e}); falling back "
+                "to flat device order — ring hops may cross non-adjacent links"
+            )
     arr = np.asarray(devices).reshape(data_size, ring_size)
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+def initialize_multihost(**kwargs) -> None:
+    """Join a multi-host (multi-process) TPU job before building meshes.
+
+    Thin passthrough to ``jax.distributed.initialize`` — on TPU pods the
+    coordinator/process-count/process-id are discovered from the
+    environment automatically, so a bare call suffices.  After this,
+    ``jax.devices()`` is the *global* device list and ``create_mesh`` spans
+    the whole slice (collectives ride ICI within a slice and DCN across,
+    scheduled by XLA — the analogue of the reference's NCCL multi-node
+    process groups, SURVEY §2.3).
+    """
+    jax.distributed.initialize(**kwargs)
 
 
 def seq_sharding(mesh: Mesh) -> NamedSharding:

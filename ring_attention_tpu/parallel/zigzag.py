@@ -27,6 +27,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash import attend_blocks, finalize, init_carry, _ungroup
+from ..ops.pallas_flash import (
+    finalize_partials,
+    init_partials,
+    merge_partials,
+    pallas_flash_partials,
+)
 
 
 def zigzag_permute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
@@ -89,13 +95,15 @@ def zigzag_attention(
     bucket_size: int | None = None,
     softclamp_value: float | None = None,
     scale: float | None = None,
+    impl: str = "xla",
 ) -> jax.Array:
     """Zig-zag sharded attention; call inside ``shard_map``.
 
     ``q, k, v: (b, [h|hk], n_local, d)`` local shards in zig-zag layout
     (``n_local = 2 * chunk``).  K/V are all-gathered over ``axis_name`` and
     un-permuted to canonical order; each local query chunk then attends its
-    end-aligned causal prefix via blockwise flash.
+    end-aligned causal prefix via blockwise flash (``impl="xla"``) or the
+    Pallas kernels (``impl="pallas"``).
     """
     assert causal, "zig-zag CP is a causal-load-balancing scheme (ref zig_zag_attention.py:102-103)"
     b, h, n_local, d = q.shape
@@ -114,6 +122,15 @@ def zigzag_attention(
     k_all = zigzag_unpermute(k_all, ring_size, axis=2)
     v_all = zigzag_unpermute(v_all, ring_size, axis=2)
 
+    # flash tile over the gathered keys: largest divisor of the global length
+    n_global = k_all.shape[2]
+    if bucket_size is not None:
+        bucket = min(bucket_size, n_global)
+        while n_global % bucket:
+            bucket -= 1
+    else:
+        bucket = None
+
     outs = []
     for which, start_expr in enumerate(
         (rank * chunk, (2 * ring_size - 1 - rank) * chunk)
@@ -121,13 +138,23 @@ def zigzag_attention(
         qc = lax.dynamic_slice_in_dim(q, which * chunk, chunk, axis=2)
         # causal band, end-aligned to the chunk's global end: local row i
         # (global start_expr + i) sees keys j <= start_expr + i
-        carry = init_carry(b, hk, g, chunk, d, like=qc)
-        carry = attend_blocks(
-            qc, k_all, v_all, carry,
-            scale=scale, bucket_size=bucket_size,
-            causal_offset=start_expr,
-            softclamp_value=softclamp_value,
-        )
-        out_g, _ = finalize(carry)
-        outs.append(_ungroup(out_g))
+        if impl == "pallas":
+            parts = pallas_flash_partials(
+                qc, k_all, v_all,
+                scale=scale, causal_offset=start_expr,
+                softclamp_value=softclamp_value,
+                block_q=bucket, block_k=bucket,
+            )
+            out, _ = finalize_partials(parts)
+            outs.append(out)
+        else:
+            carry = init_carry(b, hk, g, chunk, d, like=qc)
+            carry = attend_blocks(
+                qc, k_all, v_all, carry,
+                scale=scale, bucket_size=bucket,
+                causal_offset=start_expr,
+                softclamp_value=softclamp_value,
+            )
+            out_g, _ = finalize(carry)
+            outs.append(_ungroup(out_g))
     return jnp.concatenate(outs, axis=2).astype(q.dtype)

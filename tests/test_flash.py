@@ -137,3 +137,22 @@ def test_non_divisible_bucket(rng, causal):
     )(q, k, v)
     for a, b, name in zip(g_out, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-4, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_q_chunked(rng, causal):
+    """Two-level blocking (q chunks): identical values and gradients."""
+    q, k, v = make_qkv(rng)
+    ref = default_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, bucket_size=16, q_chunk_size=16)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+    g_ref = jax.grad(lambda *a: (default_attention(*a, causal=causal) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(
+        lambda *a: (
+            flash_attention(*a, causal=causal, bucket_size=16, q_chunk_size=16) ** 2
+        ).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-4, err_msg=f"d{name}")

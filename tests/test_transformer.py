@@ -31,7 +31,7 @@ def make_pair(mesh, **kw):
     ring_model = RingTransformer(use_ring=True, mesh=mesh, **common)
     ref_model = RingTransformer(
         use_ring=False, force_regular_attn=True,
-        **{k: v for k, v in common.items() if k not in ("striped", "use_pallas")},
+        **{k: v for k, v in common.items() if k not in ("striped", "use_pallas", "sequence_parallel")},
     )
     return ring_model, ref_model
 
@@ -169,3 +169,14 @@ def test_remat_parity(rng, mesh):
     np.testing.assert_allclose(l1, l2, atol=1e-6)
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+@pytest.mark.parametrize("sp", ["zigzag", "ulysses"])
+def test_transformer_sequence_parallel_modes(rng, mesh, sp):
+    """End-to-end transformer under each context-parallel scheme."""
+    ring_model, ref_model = make_pair(mesh, sequence_parallel=sp, heads=8, dim_head=4)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 63)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    np.testing.assert_allclose(
+        ring_model.apply(params, tokens), ref_model.apply(params, tokens), atol=ATOL
+    )
