@@ -377,6 +377,12 @@ def flash_attention(
         mask = None  # reference asserts causal and key-pad mask are exclusive
     causal_offset = k.shape[2] - q.shape[2] if causal else None
 
+    # pad KV once (shared by every q chunk): masked-out slots beyond nk
+    k, v, mask = _pad_kv_to_bucket(q, k, v, mask, bucket_size)
+    # causal_offset stays computed from the real nk: pad keys sit at
+    # j >= nk_real > i + offset for every real row, and the key mask
+    # excludes them for fully-padded rows anyway.
+
     nq = q.shape[2]
     if q_chunk_size is not None and nq > q_chunk_size:
         outs = []
@@ -386,36 +392,29 @@ def flash_attention(
             # chunk rows start at `start`, shifting the end-aligned band
             off_c = causal_offset + start if causal else None
             outs.append(
-                _flash_with_padding(
+                _flash_attention_core(
                     qc, k, v, mask, scale, bucket_size, off_c, window,
                     softclamp_value,
                 )
             )
         return jnp.concatenate(outs, axis=2)
-    return _flash_with_padding(
+    return _flash_attention_core(
         q, k, v, mask, scale, bucket_size, causal_offset, window,
         softclamp_value,
     )
 
 
-def _flash_with_padding(q, k, v, mask, scale, bucket_size, causal_offset,
-                        window, softclamp_value):
-
+def _pad_kv_to_bucket(q, k, v, mask, bucket_size):
     nk = k.shape[2]
-    if bucket_size is not None and nk % bucket_size != 0:
-        pad = bucket_size - nk % bucket_size
-        widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
-        k = jnp.pad(k, widths)
-        v = jnp.pad(v, widths)
-        if mask is None:
-            mask = jnp.arange(nk + pad)[None, :] < nk
-            mask = jnp.broadcast_to(mask, (q.shape[0], nk + pad))
-        else:
-            mask = jnp.pad(mask, [(0, 0), (0, pad)], constant_values=False)
-        # causal_offset stays computed from the real nk: pad keys sit at
-        # j >= nk_real > i + offset for every real row, and the key mask
-        # excludes them for fully-padded rows anyway.
-
-    return _flash_attention_core(
-        q, k, v, mask, scale, bucket_size, causal_offset, window, softclamp_value
-    )
+    if bucket_size is None or nk % bucket_size == 0:
+        return k, v, mask
+    pad = bucket_size - nk % bucket_size
+    widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
+    k = jnp.pad(k, widths)
+    v = jnp.pad(v, widths)
+    if mask is None:
+        mask = jnp.arange(nk + pad)[None, :] < nk
+        mask = jnp.broadcast_to(mask, (q.shape[0], nk + pad))
+    else:
+        mask = jnp.pad(mask, [(0, 0), (0, pad)], constant_values=False)
+    return k, v, mask

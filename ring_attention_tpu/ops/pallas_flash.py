@@ -311,6 +311,11 @@ def pallas_flash_partials(
             _sds((b * h, nq, 1), jnp.float32, q),
             _sds((b * h, nq, 1), jnp.float32, q),
         ],
+        # batch*head and q-block grid dims are independent (megacore can
+        # split them); the kv dim carries the online-softmax state
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(offs, *inputs)
 
@@ -644,6 +649,9 @@ def pallas_flash_backward(
             _sds((b * h, nk, d), jnp.float32, q),
             _sds((b * h, nk, d), jnp.float32, q),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(offs, *inputs)
 
@@ -684,6 +692,9 @@ def pallas_flash_backward(
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         ),
         out_shape=_sds((b * h, nq, d), jnp.float32, q),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(offs, *inputs)
 

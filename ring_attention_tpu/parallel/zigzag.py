@@ -27,12 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash import attend_blocks, finalize, init_carry, _ungroup
-from ..ops.pallas_flash import (
-    finalize_partials,
-    init_partials,
-    merge_partials,
-    pallas_flash_partials,
-)
+from ..ops.pallas_flash import finalize_partials, pallas_flash_partials
 
 
 def zigzag_permute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:

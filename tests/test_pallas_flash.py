@@ -132,3 +132,18 @@ def test_grad_softclamp_mask(rng):
     )(q, k, v, mask)
     for a, b, name in zip(g_out, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+def test_wide_head_dim(rng):
+    """dim_head=128 (full lane width) through fwd and bwd kernels."""
+    q, k, v = make_qkv(rng, h=2, n=256, d=128)
+    ref = default_attention(q, k, v, causal=True)
+    out = pallas_flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+    g_ref = jax.grad(lambda *a: (default_attention(*a, causal=True) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(
+        lambda *a: (pallas_flash_attention(*a, causal=True, interpret=True) ** 2).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=1e-3, err_msg=f"d{name}")
