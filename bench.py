@@ -96,6 +96,22 @@ def main() -> None:
         "unit": "TFLOPs/chip",
         "vs_baseline": 0.0,
     }
+    # fast health gate: this image's TPU tunnel can wedge such that even
+    # jax.devices() hangs; don't burn the full fallback budget in that state
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=180,
+        )
+        if probe.returncode != 0:
+            result["error"] = f"device probe failed: {probe.stderr[-300:]}"
+            print(json.dumps(result))
+            return
+    except subprocess.TimeoutExpired:
+        result["error"] = "device probe hung (TPU tunnel unresponsive after 180s)"
+        print(json.dumps(result))
+        return
+
     attempts = [
         ("pallas", TARGET_SEQ, 1500),
         ("pallas", 65536, 900),

@@ -266,17 +266,18 @@ class RingAttention(nn.Module):
                 "max_lookback_seq_len requires causal attention "
                 "(ref ring_flash_attention.py:99)"
             )
-            if self.striped:
-                # striped layout has no contiguous local band; approximate at
-                # hop granularity like the reference (ring_flash_attention.py:95-103)
-                max_ring_passes = math.ceil(lookback / n_local)
-            else:
-                # exact sliding window: a query at local row 0 must still see
-                # window-1 tokens back, so cover ceil((window-1)/n_local)
-                # earlier shards plus its own (tighter than the reference,
-                # which truncates early rows at bucket granularity)
-                window = lookback
+            window = lookback
+            if not self.striped:
+                # contiguous layout: distant hops carry no in-window keys, so
+                # cover ceil((window-1)/n_local) earlier shards plus our own
+                # (exact — the reference truncates early rows at bucket
+                # granularity, ring_flash_attention.py:95-103)
                 max_ring_passes = math.ceil((lookback - 1) / n_local) + 1
+            # striped layout: windows are exact too (per-hop band lower
+            # offsets, parallel/ring.py), but striping interleaves tokens so
+            # every hop holds some in-window keys — all passes run.  Prefer
+            # non-striped for windowed attention: the window itself balances
+            # causal load and allows hop skipping.
 
         def core(q, k, v, mask):
             rank = lax.axis_index(SEQ_AXIS)

@@ -18,9 +18,9 @@ Three public layers:
   - ``flash_attention`` — user-facing, ``jax.custom_vjp``-differentiable
     exact attention (GQA, causal/banded masks, key-padding, softclamp).
 
-Masking is unified into a single *banded causal offset*: a tile ``(i, j)``
-of local indices attends iff ``j <= i + offset`` (and optionally
-``j >= i + offset - window + 1`` for lookback windows).  Plain causal
+Masking is unified into a single *band of index offsets*: a tile ``(i, j)``
+of local indices attends iff ``window_lo <= j - i <= offset`` (the lower
+bound only when a lookback window applies).  Plain causal
 attention over contiguous shards is ``offset = q_start - k_start``; striped
 ring attention is ``offset = 0`` (inclusive diagonal) or ``-1`` (strict)
 depending on rank order — this replaces the reference's three separate mask
@@ -116,7 +116,7 @@ def _tile_mask(
     bk: int,
     j0: jax.Array | int,
     offset: jax.Array | int | None,
-    window: int | None,
+    window_lo: jax.Array | int | None,
     kv_mask_tile: jax.Array | None,
 ) -> jax.Array | None:
     """Boolean (…, nq, bk) tile mask (True = attend), or None if unmasked.
@@ -129,8 +129,11 @@ def _tile_mask(
         i = jnp.arange(nq)[:, None]
         j = j0 + jnp.arange(bk)[None, :]
         band = j <= i + offset
-        if window is not None:
-            band = band & (j >= i + offset - (window - 1))
+        if window_lo is not None:
+            # absolute lower offset: j >= i + window_lo (exact sliding
+            # windows in both contiguous and striped layouts — callers
+            # compute the right lo per layout/hop)
+            band = band & (j >= i + window_lo)
         masks.append(band)
     if kv_mask_tile is not None:
         # (b, bk) -> (b, 1, 1, 1, bk)
@@ -167,18 +170,23 @@ def attend_blocks(
     scale: float,
     bucket_size: int | None = None,
     causal_offset: jax.Array | int | None = None,
-    window: int | None = None,
+    window_lo: jax.Array | int | None = None,
     kv_mask: jax.Array | None = None,  # (b, nk) True = attend
     softclamp_value: float | None = None,
 ) -> FlashCarry:
-    """Fold one KV span into the running carry, scanning over KV buckets."""
+    """Fold one KV span into the running carry, scanning over KV buckets.
+
+    ``window_lo`` is the band's absolute lower offset (attend iff
+    ``window_lo <= j - i <= causal_offset``); for a contiguous layout with a
+    token window ``w`` it is ``causal_offset - (w - 1)``.
+    """
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     qg = _group_q(q, hk)
 
     if bucket_size is None or bucket_size >= nk:
         s = _tile_scores(qg, k, scale, softclamp_value)
-        mask = _tile_mask(nq, nk, 0, causal_offset, window, kv_mask)
+        mask = _tile_mask(nq, nk, 0, causal_offset, window_lo, kv_mask)
         if mask is not None:
             s = jnp.where(mask, s, MASK_VALUE)
         return _online_update(carry, s, v)
@@ -200,7 +208,7 @@ def attend_blocks(
         else:
             jb, k_j, v_j, m_j = xs
         s = _tile_scores(qg, k_j, scale, softclamp_value)
-        mask = _tile_mask(nq, bucket_size, jb * bucket_size, causal_offset, window, m_j)
+        mask = _tile_mask(nq, bucket_size, jb * bucket_size, causal_offset, window_lo, m_j)
         if mask is not None:
             s = jnp.where(mask, s, MASK_VALUE)
         return _online_update(c, s, v_j), None
@@ -226,11 +234,12 @@ def finalize(carry: FlashCarry) -> tuple[jax.Array, jax.Array]:
 def _flash_fwd_impl(q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value):
     b, h, nq, d = q.shape
     hk = k.shape[1]
+    window_lo = causal_offset - (window - 1) if window is not None else None
     carry = init_carry(b, hk, h // hk, nq, d, like=q)
     carry = attend_blocks(
         q, k, v, carry,
         scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
-        window=window, kv_mask=kv_mask, softclamp_value=softclamp_value,
+        window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
     )
     out_g, lse = finalize(carry)
     return _ungroup(out_g).astype(q.dtype), lse
@@ -247,7 +256,7 @@ def flash_backward_blocks(
     scale: float,
     bucket_size: int | None = None,
     causal_offset: jax.Array | int | None = None,
-    window: int | None = None,
+    window_lo: jax.Array | int | None = None,
     kv_mask: jax.Array | None = None,
     softclamp_value: float | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -281,7 +290,7 @@ def flash_backward_blocks(
         else:
             jb, k_j, v_j, m_j = xs
         s = _tile_scores(qg, k_j, scale, softclamp_value)
-        mask = _tile_mask(nq, bk, jb * bk, causal_offset, window, m_j)
+        mask = _tile_mask(nq, bk, jb * bk, causal_offset, window_lo, m_j)
         p = jnp.exp(s - lse[..., None])  # (b,hk,g,nq,bk)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
@@ -329,11 +338,12 @@ def _flash_core_fwd(q, k, v, kv_mask, scale, bucket_size, causal_offset, window,
 def _flash_core_bwd(scale, bucket_size, causal_offset, window, softclamp_value, res, do):
     q, k, v, kv_mask, out, lse = res
     hk = k.shape[1]
+    window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (_group_q(do, hk).astype(jnp.float32) * _group_q(out, hk).astype(jnp.float32)).sum(-1)
     dq, dk, dv = flash_backward_blocks(
         do, q, k, v, lse, delta,
         scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
-        window=window, kv_mask=kv_mask, softclamp_value=softclamp_value,
+        window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
 

@@ -9,8 +9,9 @@ rather than mutating kernel state, which is the idiom XLA can pipeline
 with the ring ``ppermute``.
 
 Masking uses the same unified *banded causal offset* contract as
-``ops/flash.py`` (plain causal = offset, striped diagonal = 0/-1, windows =
-band width), passed as a runtime scalar in SMEM so one compiled kernel
+``ops/flash.py`` (attend iff ``lo <= j - i <= hi``: plain causal hi =
+offset, striped diagonal hi = 0/-1, windows via the lo offset), passed as
+runtime scalars in SMEM so one compiled kernel
 serves every ring position under SPMD (the reference compiles
 ``CAUSAL_MASK_DIAGONAL`` variants instead, ref ``triton_flash_attn.py:216-221``).
 
@@ -91,13 +92,12 @@ def _block_sizes(nq: int, nk: int, block_q: int | None, block_k: int | None):
 
 def _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed):
     """Block-level skip predicate: does tile (rows row0.., cols col0..) touch
-    the causal band?  True when not causal."""
+    the band ``offs[1] <= j - i <= offs[0]``?  True when not causal."""
     if not causal:
         return True
-    offs = offs_ref[0]
-    ok = col0 <= row0 + bq - 1 + offs
+    ok = col0 <= row0 + bq - 1 + offs_ref[0]
     if windowed:
-        ok = jnp.logical_and(ok, col0 + bk - 1 >= row0 + offs - (offs_ref[1] - 1))
+        ok = jnp.logical_and(ok, col0 + bk - 1 >= row0 + offs_ref[1])
     return ok
 
 
@@ -114,10 +114,9 @@ def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
     cols = col0 + lax.broadcasted_iota(jnp.int32, shape, 1 - q_dim)
     keep = None
     if causal:
-        offs = offs_ref[0]
-        keep = cols <= rows + offs
+        keep = cols <= rows + offs_ref[0]
         if windowed:
-            keep = jnp.logical_and(keep, cols >= rows + offs - (offs_ref[1] - 1))
+            keep = jnp.logical_and(keep, cols >= rows + offs_ref[1])
     if masked:
         kvm = kvm_ref[0] != 0
         kvm = kvm[None, :] if q_dim == 0 else kvm[:, None]
@@ -132,7 +131,7 @@ def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
 
 def _fwd_kernel(
     # scalar prefetch
-    offs_ref,  # (2,) int32: [causal_offset, window] (sentinels if unused)
+    offs_ref,  # (2,) int32: [band hi offset, band lo offset] (0 if unused)
     # inputs
     q_ref,  # (1, bq, d)
     k_ref,  # (1, bk, d)
@@ -222,13 +221,17 @@ def pallas_flash_partials(
     *,
     scale: float,
     causal_offset: jax.Array | int | None = None,
-    window: int | None = None,
+    window_lo: jax.Array | int | None = None,
     softclamp_value: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
 ) -> FlashPartials:
-    """One flash sweep over a KV span, returning mergeable partials."""
+    """One flash sweep over a KV span, returning mergeable partials.
+
+    ``window_lo``: absolute band lower offset (see ``ops/flash.py``);
+    may be a traced per-device scalar under SPMD.
+    """
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
@@ -236,13 +239,13 @@ def pallas_flash_partials(
     interpret = _interpret_default() if interpret is None else interpret
 
     causal = causal_offset is not None
-    windowed = window is not None and causal
+    windowed = window_lo is not None and causal
     masked = kv_mask is not None
 
     offs = jnp.asarray(
         [
             causal_offset if causal else 0,
-            window if windowed else 0,
+            window_lo if windowed else 0,
         ],
         jnp.int32,
     )
@@ -544,7 +547,7 @@ def pallas_flash_backward(
     *,
     scale: float,
     causal_offset: jax.Array | int | None = None,
-    window: int | None = None,
+    window_lo: jax.Array | int | None = None,
     softclamp_value: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
@@ -559,10 +562,10 @@ def pallas_flash_backward(
     interpret = _interpret_default() if interpret is None else interpret
 
     causal = causal_offset is not None
-    windowed = window is not None and causal
+    windowed = window_lo is not None and causal
     masked = kv_mask is not None
     offs = jnp.asarray(
-        [causal_offset if causal else 0, window if windowed else 0], jnp.int32
+        [causal_offset if causal else 0, window_lo if windowed else 0], jnp.int32
     )
 
     q, k, v, do, lse, delta, kv_mask, offs = _unify_vma(
@@ -717,9 +720,10 @@ def _pallas_flash_core(q, k, v, kv_mask, scale, causal_offset, window,
 
 def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
                            softclamp_value, interpret):
+    window_lo = causal_offset - (window - 1) if window is not None else None
     parts = pallas_flash_partials(
         q, k, v, kv_mask,
-        scale=scale, causal_offset=causal_offset, window=window,
+        scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, interpret=interpret,
     )
     out, lse = finalize_partials(parts)
@@ -737,10 +741,11 @@ def _pallas_flash_core_fwd(q, k, v, kv_mask, scale, causal_offset, window,
 def _pallas_flash_core_bwd(scale, causal_offset, window, softclamp_value,
                            interpret, res, do):
     q, k, v, kv_mask, out, lse = res
+    window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
     dq, dk, dv = pallas_flash_backward(
         do, q, k, v, lse, delta, kv_mask,
-        scale=scale, causal_offset=causal_offset, window=window,
+        scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, interpret=interpret,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None

@@ -77,33 +77,51 @@ def _rotate(x, axis_name: str):
     return lax.ppermute(x, axis_name, _ring_perm(axis_name))
 
 
-def _hop_offset(
+def _hop_offsets(
     rank: jax.Array,
     origin: jax.Array,
     n_local: int,
     causal: bool,
     striped: bool,
-) -> jax.Array | None:
-    """Banded-causal offset for the tile (my queries) x (origin's keys)."""
+    window: int | None,
+    ring_size: int,
+) -> tuple[jax.Array | None, jax.Array | None]:
+    """Band offsets (hi, lo) for the tile (my queries) x (origin's keys).
+
+    Attend iff ``lo <= j - i <= hi`` in local indices.  Contiguous layout:
+    ``hi = (rank - origin) * n_local``, ``lo = hi - (window-1)``.  Striped
+    layout (global pos ``i*W + rank`` / ``j*W + origin``): the diagonal flip
+    ``hi = 0|-1`` and — exactly, unlike the reference's bucket-granular
+    approximation (ref ring_flash_attention.py:95-103) — the window bound
+    ``j*W + o >= i*W + r - w + 1  <=>  j >= i + ceil((r - o - w + 1)/W)``,
+    an integer scalar per hop."""
     if not causal:
-        return None
+        return None, None
     if striped:
-        return jnp.where(origin <= rank, 0, -1)
-    return (rank - origin) * n_local
+        hi = jnp.where(origin <= rank, 0, -1)
+        if window is None:
+            return hi, None
+        lo = -((origin + window - 1 - rank) // ring_size)  # ceil division
+        return hi, lo
+    hi = (rank - origin) * n_local
+    lo = hi - (window - 1) if window is not None else None
+    return hi, lo
 
 
 def _hop_has_work(
-    offset: jax.Array | None, n_local: int, window: int | None
+    hi: jax.Array | None, lo: jax.Array | None, n_local: int
 ) -> jax.Array:
-    if offset is None:
+    if hi is None:
         return jnp.bool_(True)
-    lo = offset >= -(n_local - 1)
-    if window is not None:
-        return lo & (offset - (window - 1) <= n_local - 1)
-    return lo
+    ok = hi >= -(n_local - 1)
+    if lo is not None:
+        # lo > hi means an empty band: striped hops with window < ring_size
+        # hold no in-window keys at all and can skip entirely
+        return ok & (lo <= n_local - 1) & (lo <= hi)
+    return ok
 
 
-def _span_ops(impl, q, hk, scale, bucket_size, window, softclamp_value):
+def _span_ops(impl, q, hk, scale, bucket_size, softclamp_value):
     """Per-hop (init, attend, final) for the chosen compute path.
 
     The carry is the online-softmax state; ``attend`` folds one KV span
@@ -117,10 +135,10 @@ def _span_ops(impl, q, hk, scale, bucket_size, window, softclamp_value):
         def init():
             return init_partials(b, h, n_local, d, like=q)
 
-        def attend(carry, k, v, kv_mask, offset):
+        def attend(carry, k, v, kv_mask, hi, lo):
             parts = pallas_flash_partials(
                 q, k, v, kv_mask,
-                scale=scale, causal_offset=offset, window=window,
+                scale=scale, causal_offset=hi, window_lo=lo,
                 softclamp_value=softclamp_value,
                 block_q=bucket_size, block_k=bucket_size,
             )
@@ -135,11 +153,11 @@ def _span_ops(impl, q, hk, scale, bucket_size, window, softclamp_value):
         def init():
             return init_carry(b, hk, g, n_local, d, like=q)
 
-        def attend(carry, k, v, kv_mask, offset):
+        def attend(carry, k, v, kv_mask, hi, lo):
             return attend_blocks(
                 q, k, v, carry,
-                scale=scale, bucket_size=bucket_size, causal_offset=offset,
-                window=window, kv_mask=kv_mask,
+                scale=scale, bucket_size=bucket_size, causal_offset=hi,
+                window_lo=lo, kv_mask=kv_mask,
                 softclamp_value=softclamp_value,
             )
 
@@ -150,20 +168,20 @@ def _span_ops(impl, q, hk, scale, bucket_size, window, softclamp_value):
     return init, attend, final
 
 
-def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, offset, scale,
-              bucket_size, window, softclamp_value, hk):
+def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
+              bucket_size, softclamp_value, hk):
     """Per-hop backward: returns (dq (b,h,..), dk (b,hk,..), dv (b,hk,..))."""
     if impl == "pallas":
         return pallas_flash_backward(
             do, q, k, v, lse, delta, kv_mask,
-            scale=scale, causal_offset=offset, window=window,
+            scale=scale, causal_offset=hi, window_lo=lo,
             softclamp_value=softclamp_value,
             block_q=bucket_size, block_k=bucket_size,
         )
     return flash_backward_blocks(
         do, q, k, v, lse, delta,
-        scale=scale, bucket_size=bucket_size, causal_offset=offset,
-        window=window, kv_mask=kv_mask, softclamp_value=softclamp_value,
+        scale=scale, bucket_size=bucket_size, causal_offset=hi,
+        window_lo=lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
     )
 
 
@@ -201,7 +219,8 @@ def ring_flash_attention(
       bucket_size: flash tile size within a hop.
       max_ring_passes: limit hops for per-layer lookback windows
         (ref ``ring_flash_attention.py:95-103``).
-      window: exact sliding-window lookback in tokens (non-striped only).
+      window: exact sliding-window lookback in tokens (exact in both
+        contiguous and striped layouts).
       impl: per-hop compute path, ``"xla"`` or ``"pallas"``.
 
     Returns:
@@ -214,20 +233,12 @@ def ring_flash_attention(
     return out
 
 
-def _check_window(causal, striped, window):
-    if window is not None:
-        assert causal, "lookback windows require causal attention"
-        assert not striped, (
-            "windows apply to contiguous (non-striped) layouts; striped "
-            "lookback is approximated with max_ring_passes instead"
-        )
-
-
 def _ring_fwd_impl(
     q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl,
 ):
-    _check_window(causal, striped, window)
+    if window is not None:
+        assert causal, "lookback windows require causal attention"
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     if scale is None:
@@ -237,7 +248,7 @@ def _ring_fwd_impl(
     rank = lax.axis_index(axis_name)
 
     init, attend, final = _span_ops(
-        impl, q, hk, scale, bucket_size, window, softclamp_value
+        impl, q, hk, scale, bucket_size, softclamp_value
     )
     carry = init()
     kv = jnp.stack([k, v])  # one message per hop, ref ring_flash_attention.py:129
@@ -245,12 +256,14 @@ def _ring_fwd_impl(
 
     def hop(i, flash, kv, mask_carry):
         origin = (rank - i) % ring_size
-        offset = _hop_offset(rank, origin, n_local, causal, striped)
-        has_work = _hop_has_work(offset, n_local, window)
+        hi, lo = _hop_offsets(
+            rank, origin, n_local, causal, striped, window, ring_size
+        )
+        has_work = _hop_has_work(hi, lo, n_local)
 
         flash = lax.cond(
             has_work,
-            lambda f: attend(f, kv[0], kv[1], mask_carry, offset),
+            lambda f: attend(f, kv[0], kv[1], mask_carry, hi, lo),
             lambda f: f,
             flash,
         )
@@ -319,14 +332,16 @@ def _ring_vjp_bwd(
 
     def hop(i, dq, kv, dkv, mask_carry):
         origin = (rank - i) % ring_size
-        offset = _hop_offset(rank, origin, n_local, causal, striped)
-        has_work = _hop_has_work(offset, n_local, window)
+        hi, lo = _hop_offsets(
+            rank, origin, n_local, causal, striped, window, ring_size
+        )
+        has_work = _hop_has_work(hi, lo, n_local)
 
         def do_bwd(args):
             dq, dkv = args
             dq_i, dk_i, dv_i = _span_bwd(
-                impl, do, q, kv[0], kv[1], lse, delta, mask_carry, offset,
-                scale, bucket_size, window, softclamp_value, hk,
+                impl, do, q, kv[0], kv[1], lse, delta, mask_carry, hi, lo,
+                scale, bucket_size, softclamp_value, hk,
             )
             return dq + dq_i, dkv.at[0].add(dk_i).at[1].add(dv_i)
 

@@ -143,3 +143,17 @@ def test_module_ulysses_mask_grads(rng, mesh):
     g_ref = jax.grad(lambda x: (ref_mod.apply(params, x, mask) ** 2).sum())(x)
     g_out = jax.grad(lambda x: (ring_mod.apply(params, x, mask) ** 2).sum())(x)
     np.testing.assert_allclose(g_out, g_ref, atol=GRAD_ATOL)
+
+
+def test_module_lookback_striped(rng, mesh):
+    """Striped + lookback is exact end-to-end through the module."""
+    common = dict(dim=32, heads=4, dim_head=8, bucket_size=4, causal=True,
+                  max_lookback_seq_len=8)
+    ring_mod = RingAttention(use_ring=True, auto_shard=True, mesh=mesh,
+                             striped=True, **common)
+    ref_mod = RingAttention(use_ring=False, **common)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32)), jnp.float32)
+    params = ref_mod.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        ring_mod.apply(params, x), ref_mod.apply(params, x), atol=ATOL
+    )

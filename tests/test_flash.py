@@ -156,3 +156,20 @@ def test_q_chunked(rng, causal):
     )(q, k, v)
     for a, b, name in zip(g_out, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-4, err_msg=f"d{name}")
+
+
+def test_bf16_long_accumulation(rng):
+    """bf16 inputs over a longer sequence: f32 online-softmax accumulators
+    must keep flash within bf16 round-off of the f32 oracle (the reference
+    keeps m/lse fp32 for the same reason, ring_flash_attention_cuda.py:251-259)."""
+    n = 2048
+    q = jnp.asarray(rng.standard_normal((1, 2, n, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, n, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, n, 32)), jnp.float32)
+    ref = default_attention(q, k, v, causal=True)
+    out = flash_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+        causal=True, bucket_size=256, q_chunk_size=512,
+    )
+    # bf16 has ~3 decimal digits; inputs O(1), outputs O(1)
+    np.testing.assert_allclose(out.astype(jnp.float32), ref, atol=3e-2)
