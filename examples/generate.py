@@ -25,6 +25,12 @@ def main() -> None:
     ap.add_argument("--max-len", type=int, default=128)
     args = ap.parse_args()
 
+    if args.prompt_len + args.steps - 1 > args.max_len:
+        ap.error(
+            f"--max-len {args.max_len} too small for prompt {args.prompt_len} "
+            f"+ {args.steps} steps (cache writes would clamp silently)"
+        )
+
     if args.fake_devices:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
