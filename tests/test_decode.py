@@ -22,12 +22,14 @@ def _decode_all(model, params, tokens, max_len):
     """Run decode_step over each token; stack per-step logits."""
     b, n = tokens.shape
     cache = model.apply(params, b, max_len, method=RingTransformer.init_cache)
+    step = jax.jit(
+        lambda p, tok, c, i: model.apply(
+            p, tok, c, i, method=RingTransformer.decode_step
+        )
+    )
     outs = []
     for i in range(n):
-        logits, cache = model.apply(
-            params, tokens[:, i], cache, jnp.int32(i),
-            method=RingTransformer.decode_step,
-        )
+        logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
         outs.append(logits)
     return jnp.stack(outs, axis=1)  # (b, n, vocab)
 
