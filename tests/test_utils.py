@@ -1,4 +1,6 @@
-"""Checkpoint round-trip and throughput meter."""
+"""Checkpoint round-trip, throughput meter, and trace context."""
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -6,7 +8,7 @@ import numpy as np
 import pytest
 
 from ring_attention_tpu.models import RingTransformer
-from ring_attention_tpu.utils import StepTimer, restore_checkpoint, save_checkpoint
+from ring_attention_tpu.utils import StepTimer, restore_checkpoint, save_checkpoint, trace
 
 VOCAB = 64
 
@@ -47,3 +49,11 @@ def test_step_timer():
         t.step(jnp.ones(()))
     assert t.steps_per_sec > 0
     assert t.tokens_per_sec == 100 * t.steps_per_sec
+
+
+def test_trace_context(tmp_path):
+    """XProf trace context manager writes a profile directory."""
+    logdir = str(tmp_path / "profile")
+    with trace(logdir):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    assert os.path.isdir(logdir) and os.listdir(logdir)
