@@ -112,34 +112,55 @@ def main() -> None:
         print(json.dumps(result))
         return
 
+    # strategy: one quick config first (guarantees a real measurement), then
+    # the north-star config directly; intermediate sizes only as fallbacks
+    # if the target fails.  Later successes upgrade the reported number.
     attempts = [
-        ("pallas", TARGET_SEQ, 1500),
-        ("pallas", 65536, 900),
-        ("pallas", 16384, 600),
-        ("xla", 65536, 900),
-        ("xla", 8192, 600),
+        ("xla", 8192, 420, False),
+        ("pallas", TARGET_SEQ, 1500, False),
+        ("pallas", 65536, 900, True),   # fallback-only
+        ("pallas", 16384, 600, True),   # fallback-only
     ]
-    errors = []
-    for impl, seq, budget in attempts:
+    deadline = time.monotonic() + float(os.environ.get("BENCH_BUDGET_S", 3600))
+    log = []
+    got_target = False
+    got_fallback = False
+    got_any = False
+    for impl, seq, budget, fallback_only in attempts:
+        # fallbacks are ordered largest-first: stop after the first success
+        # so a smaller one never overwrites it
+        if fallback_only and (got_target or got_fallback):
+            continue
+        remaining = deadline - time.monotonic()
+        if remaining < budget / 3:
+            log.append(f"{impl}@{seq}: skipped (budget exhausted)")
+            continue
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--worker", impl, str(seq)],
                 capture_output=True,
                 text=True,
-                timeout=budget,
+                timeout=min(budget, remaining),
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
             if proc.returncode == 0:
                 line = proc.stdout.strip().splitlines()[-1]
                 result.update(json.loads(line))
-                break
-            errors.append(f"{impl}@{seq}: rc={proc.returncode} {proc.stderr[-200:]}")
+                got_any = True
+                got_target = got_target or seq == TARGET_SEQ
+                got_fallback = got_fallback or fallback_only
+                log.append(f"{impl}@{seq}: ok")
+                continue
+            log.append(f"{impl}@{seq}: rc={proc.returncode} {proc.stderr[-200:]}")
         except subprocess.TimeoutExpired:
-            errors.append(f"{impl}@{seq}: timeout {budget}s")
+            log.append(f"{impl}@{seq}: timeout")
         except Exception:
-            errors.append(f"{impl}@{seq}: {traceback.format_exc(limit=1)}")
-    else:
-        result["error"] = " | ".join(errors)[-500:]
+            log.append(f"{impl}@{seq}: {traceback.format_exc(limit=1)}")
+    # keep the attempt trail even on success so a fallback-sized result is
+    # never mistaken for a clean north-star run round-over-round
+    result["attempts"] = " | ".join(log)[-500:]
+    if not got_any:
+        result["error"] = result["attempts"]
     print(json.dumps(result))
 
 
