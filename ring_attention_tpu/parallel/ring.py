@@ -239,6 +239,11 @@ def _ring_fwd_impl(
 ):
     if window is not None:
         assert causal, "lookback windows require causal attention"
+    assert q.shape[2] == k.shape[2], (
+        "ring attention requires equal q/kv shard lengths (self-attention); "
+        "for cross-attention use flash_attention — the reference likewise "
+        "disables the ring for cross-attn (ref ring_flash_attention.py:81-83)"
+    )
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     if scale is None:

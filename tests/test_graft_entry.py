@@ -4,13 +4,15 @@ These are the integration points an external harness exercises; breaking
 them silently would cost a whole round.
 """
 
+import os
 import sys
 
 import jax
 import jax.numpy as jnp
 import pytest
 
-sys.path.insert(0, "/root/repo")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
 
 def test_entry_compiles():
@@ -30,13 +32,13 @@ def test_dryrun_multichip_8():
 def test_bench_worker_contract():
     """bench.py --worker prints one parseable JSON measurement line."""
     import json
-    import os
     import subprocess
 
+    bench_path = os.path.join(REPO_ROOT, "bench.py")
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu');"
         "import sys; sys.argv = ['bench.py', '--worker', 'xla', '1024'];"
-        "exec(open('/root/repo/bench.py').read())"
+        f"exec(open({bench_path!r}).read())"
     )
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
