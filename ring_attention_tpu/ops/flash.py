@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .attention import MASK_VALUE, EPSILON, softclamp
+from ..utils.validate import check_attention_args
 
 
 class FlashCarry(NamedTuple):
@@ -315,9 +316,10 @@ def flash_backward_blocks(
     return _ungroup(dq_g), dk, dv
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_attention_core(q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value):
-    """custom_vjp core; ``causal_offset`` is a static int or None (no mask).
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_attention_core(q, k, v, kv_mask, causal_offset, scale, bucket_size, window, softclamp_value):
+    """custom_vjp core; ``causal_offset`` is an int scalar (possibly traced —
+    the q-chunked path scans over per-chunk offsets) or None (no mask).
 
     An end-aligned offset (``nk - nq``) supports decode-style ``nq < nk``
     calls exactly like the oracle (ops/attention.py).
@@ -328,15 +330,15 @@ def _flash_attention_core(q, k, v, kv_mask, scale, bucket_size, causal_offset, w
     return out
 
 
-def _flash_core_fwd(q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value):
+def _flash_core_fwd(q, k, v, kv_mask, causal_offset, scale, bucket_size, window, softclamp_value):
     out, lse = _flash_fwd_impl(
         q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value
     )
-    return out, (q, k, v, kv_mask, out, lse)
+    return out, (q, k, v, kv_mask, causal_offset, out, lse)
 
 
-def _flash_core_bwd(scale, bucket_size, causal_offset, window, softclamp_value, res, do):
-    q, k, v, kv_mask, out, lse = res
+def _flash_core_bwd(scale, bucket_size, window, softclamp_value, res, do):
+    q, k, v, kv_mask, causal_offset, out, lse = res
     hk = k.shape[1]
     window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (_group_q(do, hk).astype(jnp.float32) * _group_q(out, hk).astype(jnp.float32)).sum(-1)
@@ -345,7 +347,7 @@ def _flash_core_bwd(scale, bucket_size, causal_offset, window, softclamp_value, 
         scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
         window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
 
 _flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -379,6 +381,7 @@ def flash_attention(
     Pallas kernels tile both dimensions natively).  Gradients of the shared
     K/V sum across chunks through autodiff.
     """
+    check_attention_args("flash_attention", q, k, v, mask)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if window is not None:
@@ -395,21 +398,47 @@ def flash_attention(
 
     nq = q.shape[2]
     if q_chunk_size is not None and nq > q_chunk_size:
-        outs = []
-        for start in range(0, nq, q_chunk_size):
-            stop = min(start + q_chunk_size, nq)  # ragged tail chunk is fine
-            qc = lax.slice_in_dim(q, start, stop, axis=2)
-            # chunk rows start at `start`, shifting the end-aligned band
-            off_c = causal_offset + start if causal else None
-            outs.append(
-                _flash_attention_core(
-                    qc, k, v, mask, scale, bucket_size, off_c, window,
+        # lax.scan over equal-size q chunks: the chunk body compiles ONCE
+        # regardless of chunk count (a Python loop here unrolled one
+        # custom_vjp core per chunk — 128 copies at seq 262144 — blowing
+        # compile time on exactly the long sequences this option targets).
+        # The per-chunk causal offset rides the scan as a traced scalar;
+        # K/V/mask are scan constants, so their grads accumulate through
+        # the scan transpose.
+        cq = q_chunk_size
+        pad_q = (-nq) % cq
+        if pad_q:
+            q = jnp.pad(q, [(0, 0), (0, 0), (0, pad_q), (0, 0)])
+        b, h, _, d = q.shape
+        nc = (nq + pad_q) // cq
+        qs = jnp.moveaxis(q.reshape(b, h, nc, cq, d), 2, 0)  # (nc, b, h, cq, d)
+
+        if causal:
+            # chunk rows start at start=i*cq, shifting the end-aligned band
+            offs = causal_offset + jnp.arange(nc, dtype=jnp.int32) * cq
+
+            def body(_, xs):
+                qc, off = xs
+                return None, _flash_attention_core(
+                    qc, k, v, mask, off, scale, bucket_size, window,
                     softclamp_value,
                 )
-            )
-        return jnp.concatenate(outs, axis=2)
+
+            _, outs = lax.scan(body, None, (qs, offs))
+        else:
+
+            def body(_, qc):
+                return None, _flash_attention_core(
+                    qc, k, v, mask, None, scale, bucket_size, window,
+                    softclamp_value,
+                )
+
+            _, outs = lax.scan(body, None, qs)
+
+        out = jnp.moveaxis(outs, 0, 2).reshape(b, h, nc * cq, d)
+        return out[:, :, :nq] if pad_q else out
     return _flash_attention_core(
-        q, k, v, mask, scale, bucket_size, causal_offset, window,
+        q, k, v, mask, causal_offset, scale, bucket_size, window,
         softclamp_value,
     )
 
