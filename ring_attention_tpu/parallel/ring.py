@@ -66,6 +66,7 @@ from ..ops.pallas_flash import (
     pallas_flash_backward,
     pallas_flash_partials,
 )
+from ..utils.validate import check_attention_args
 
 
 def _ring_perm(axis_name: str, shift: int = 1) -> list[tuple[int, int]]:
@@ -185,10 +186,6 @@ def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
     )
 
 
-@partial(
-    jax.custom_vjp,
-    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12),
-)
 def ring_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -223,9 +220,45 @@ def ring_flash_attention(
         contiguous and striped layouts).
       impl: per-hop compute path, ``"xla"`` or ``"pallas"``.
 
+    Cross-attention (unequal q/kv shard lengths) silently bypasses the ring
+    and runs local flash over the local KV shard — the reference degrades
+    the same way (ref ``ring_flash_attention.py:81-83``).
+
     Returns:
       ``(b, h, n_local, d)`` output shard, in ``q.dtype``.
     """
+    check_attention_args("ring_flash_attention", q, k, v, kv_mask)
+    if q.shape[2] != k.shape[2]:
+        # Cross-attention: each device attends its local KV shard only,
+        # exactly like the reference's non-ring fallback.  The causal band
+        # (if any) is end-aligned by flash_attention.
+        from ..ops.flash import flash_attention
+        from ..ops.pallas_flash import pallas_flash_attention
+
+        if impl == "pallas":
+            return pallas_flash_attention(
+                q, k, v, kv_mask, causal=causal, window=window,
+                softclamp_value=softclamp_value, scale=scale,
+            )
+        return flash_attention(
+            q, k, v, kv_mask, causal=causal, bucket_size=bucket_size,
+            window=window, softclamp_value=softclamp_value, scale=scale,
+        )
+    return _ring_flash_attention_core(
+        q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+        max_ring_passes, window, softclamp_value, scale, impl,
+    )
+
+
+@partial(
+    jax.custom_vjp,
+    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12),
+)
+def _ring_flash_attention_core(
+    q, k, v, kv_mask, axis_name, causal=False, striped=False,
+    bucket_size=None, max_ring_passes=None, window=None,
+    softclamp_value=None, scale=None, impl="xla",
+):
     out, _ = _ring_fwd_impl(
         q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
         max_ring_passes, window, softclamp_value, scale, impl,
@@ -239,11 +272,6 @@ def _ring_fwd_impl(
 ):
     if window is not None:
         assert causal, "lookback windows require causal attention"
-    assert q.shape[2] == k.shape[2], (
-        "ring attention requires equal q/kv shard lengths (self-attention); "
-        "for cross-attention use flash_attention — the reference likewise "
-        "disables the ring for cross-attn (ref ring_flash_attention.py:81-83)"
-    )
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     if scale is None:
@@ -391,4 +419,4 @@ def _ring_vjp_bwd(
     )
 
 
-ring_flash_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+_ring_flash_attention_core.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)

@@ -291,6 +291,41 @@ def test_ring_striped_window_exact(rng, mesh, impl):
         np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ring_cross_attention_degrades(rng, mesh, impl):
+    """Unequal q/kv shard lengths (cross-attention): the ring entry bypasses
+    the ring and runs local flash per shard, exactly like the reference's
+    silent non-ring fallback (ref ring_flash_attention.py:81-83) — instead
+    of hard-failing.  Oracle: dense attention of each q shard against its
+    own KV shard, fwd and bwd."""
+    b, h, d, ring = 2, 4, 16, 8
+    nq, nk = 64, 128  # per-shard 8 vs 16
+    q = jnp.asarray(rng.standard_normal((b, h, nq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, nk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, nk, d)), jnp.float32)
+
+    def oracle(q, k, v):
+        qs = q.reshape(b, h, ring, nq // ring, d)
+        ks = k.reshape(b, h, ring, nk // ring, d)
+        vs = v.reshape(b, h, ring, nk // ring, d)
+        outs = [
+            default_attention(qs[:, :, i], ks[:, :, i], vs[:, :, i])
+            for i in range(ring)
+        ]
+        return jnp.concatenate(outs, axis=2)
+
+    out = ring_attn_global(q, k, v, mesh=mesh, impl=impl)
+    np.testing.assert_allclose(out, oracle(q, k, v), atol=ATOL)
+
+    g_ref = jax.grad(lambda *a: (oracle(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(
+        lambda *a: (ring_attn_global(*a, mesh=mesh, impl=impl) ** 2).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    for a, b_, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b_, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
 def test_ring_determinism(rng, mesh):
     """Bitwise repeatability across FRESH compilations (caches cleared
     between runs): the compiled collective schedule fixes the reduction
