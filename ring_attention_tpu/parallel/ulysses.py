@@ -17,12 +17,15 @@ kernels underneath (``impl="xla" | "pallas"``), differentiable through
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import pallas_flash_attention
+from ..utils.validate import check_attention_args
 
 
 def ulysses_attention(
@@ -41,19 +44,37 @@ def ulysses_attention(
 ) -> jax.Array:
     """Head-parallel exact attention; call inside ``shard_map``.
 
-    Requires ``h % world == 0`` and ``hk % world == 0`` (each device takes
-    ``h/world`` query heads against the full sequence).  Sequence layout is
-    contiguous (no striping needed — head parallelism is inherently
-    balanced under causal masking).
+    Requires ``h % world == 0`` (each device takes ``h/world`` query heads
+    against the full sequence).  When ``hk`` does not divide over the axis
+    (small-hk GQA), KV heads are auto-repeated up to the axis size — grads
+    sum back over the copies.  Sequence layout is contiguous (no striping
+    needed — head parallelism is inherently balanced under causal masking).
     """
+    check_attention_args("ulysses_attention", q, k, v, kv_mask, equal_qkv_len=True)
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     world = lax.axis_size(axis_name)
     assert h % world == 0, f"query heads {h} must divide over {world} devices"
-    assert hk % world == 0, (
-        f"kv heads {hk} must divide over {world} devices; "
-        "repeat kv heads up to the axis size for small-hk GQA"
-    )
+
+    if hk % world:
+        # GQA with fewer KV heads than the axis size: repeat each KV head
+        # r times so heads divide over the devices.  jnp.repeat keeps copies
+        # of head i contiguous, so query heads [i*g, (i+1)*g) still map onto
+        # copies of their own KV head after the all-to-all head split; the
+        # transpose of the repeat sums dk/dv back over the copies (the
+        # reference's GQA grad-reduce contract,
+        # ref ring_flash_attention.py:86-89,370-371).
+        gcd = math.gcd(hk, world)
+        r = world // gcd
+        g = h // hk
+        assert g % r == 0, (
+            f"cannot serve GQA with {hk} kv heads on a {world}-device ulysses "
+            f"axis: repeating kv heads x{r} needs the group size {g} to be a "
+            f"multiple of {r}"
+        )
+        k = jnp.repeat(k, r, axis=1)
+        v = jnp.repeat(v, r, axis=1)
+        hk = hk * r
 
     # seq-sharded -> head-sharded: (b, h/W, n_global, d)
     qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)

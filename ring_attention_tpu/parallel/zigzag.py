@@ -22,12 +22,19 @@ Differences by design:
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash import attend_blocks, finalize, init_carry, _ungroup
-from ..ops.pallas_flash import finalize_partials, pallas_flash_partials
+from ..ops.pallas_flash import (
+    finalize_partials,
+    pallas_flash_backward,
+    pallas_flash_partials,
+)
+from ..utils.validate import check_attention_args
 
 
 def zigzag_permute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
@@ -80,6 +87,55 @@ def zigzag_positions(n_local: int, rank: jax.Array, ring_size: int) -> jax.Array
     return jnp.concatenate([first, second])
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _pallas_chunk_attention(qc, k_all, v_all, causal_offset, scale,
+                            softclamp_value, block):
+    """Differentiable Pallas attention of one zig-zag query chunk against the
+    gathered canonical KV.  ``causal_offset`` is the chunk's global start
+    (traced — it depends on the device's rank); dk/dv flow into the
+    enclosing ``lax.all_gather``'s transpose (reduce-scatter), the analogue
+    of the reference's autograd AllGather backward (ref distributed.py:103-107)."""
+    out, _ = _pallas_chunk_fwd_impl(
+        qc, k_all, v_all, causal_offset, scale, softclamp_value, block
+    )
+    return out
+
+
+def _pallas_chunk_fwd_impl(qc, k_all, v_all, causal_offset, scale,
+                           softclamp_value, block):
+    parts = pallas_flash_partials(
+        qc, k_all, v_all,
+        scale=scale, causal_offset=causal_offset,
+        softclamp_value=softclamp_value,
+        block_q=block, block_k=block,
+    )
+    out, lse = finalize_partials(parts)
+    return out, lse
+
+
+def _pallas_chunk_vjp_fwd(qc, k_all, v_all, causal_offset, scale,
+                          softclamp_value, block):
+    out, lse = _pallas_chunk_fwd_impl(
+        qc, k_all, v_all, causal_offset, scale, softclamp_value, block
+    )
+    return out, (qc, k_all, v_all, causal_offset, out, lse)
+
+
+def _pallas_chunk_vjp_bwd(scale, softclamp_value, block, res, do):
+    qc, k_all, v_all, causal_offset, out, lse = res
+    delta = (do.astype(jnp.float32) * out).sum(-1)
+    dq, dk, dv = pallas_flash_backward(
+        do, qc, k_all, v_all, lse, delta,
+        scale=scale, causal_offset=causal_offset,
+        softclamp_value=softclamp_value,
+        block_q=block, block_k=block,
+    )
+    return dq.astype(qc.dtype), dk.astype(k_all.dtype), dv.astype(v_all.dtype), None
+
+
+_pallas_chunk_attention.defvjp(_pallas_chunk_vjp_fwd, _pallas_chunk_vjp_bwd)
+
+
 def zigzag_attention(
     q: jax.Array,
     k: jax.Array,
@@ -101,6 +157,7 @@ def zigzag_attention(
     Pallas kernels (``impl="pallas"``).
     """
     assert causal, "zig-zag CP is a causal-load-balancing scheme (ref zig_zag_attention.py:102-103)"
+    check_attention_args("zigzag_attention", q, k, v, equal_qkv_len=True)
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     g = h // hk
@@ -134,14 +191,12 @@ def zigzag_attention(
         # causal band, end-aligned to the chunk's global end: local row i
         # (global start_expr + i) sees keys j <= start_expr + i
         if impl == "pallas":
-            parts = pallas_flash_partials(
-                qc, k_all, v_all,
-                scale=scale, causal_offset=start_expr,
-                softclamp_value=softclamp_value,
-                block_q=bucket, block_k=bucket,
+            outs.append(
+                _pallas_chunk_attention(
+                    qc, k_all, v_all, start_expr, scale, softclamp_value,
+                    bucket,
+                )
             )
-            out, _ = finalize_partials(parts)
-            outs.append(out)
         else:
             carry = init_carry(b, hk, g, chunk, d, like=qc)
             carry = attend_blocks(
