@@ -17,6 +17,7 @@ from .ops import (
     apply_rotary,
     default_attention,
     flash_attention,
+    pallas_flash_attention,
     ring_positions,
     rotary_freqs,
 )
@@ -52,6 +53,7 @@ __all__ = [
     "create_mesh",
     "default_attention",
     "flash_attention",
+    "pallas_flash_attention",
     "ring_flash_attention",
     "ring_positions",
     "rotary_freqs",

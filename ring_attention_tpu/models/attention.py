@@ -37,6 +37,7 @@ from ..parallel.sharding import pad_seq_and_mask, stripe_permute, stripe_unpermu
 from ..parallel.tree_decode import tree_attn_decode
 from ..parallel.ulysses import ulysses_attention
 from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
+from ..utils.validate import check_model_input
 from .layers import RMSNorm
 
 
@@ -112,6 +113,7 @@ class RingAttention(nn.Module):
         and constrained onto the ``(data, seq)`` mesh; the inverse is applied
         to the output (ref ``ring_attention.py:389-403,458-464``).
         """
+        check_model_input("RingAttention", x, self.dim)
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
         assert self.sequence_parallel in ("ring", "zigzag", "ulysses")
         if self.sequence_parallel == "zigzag":

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.sharding import pad_to_multiple, stripe_permute, stripe_unpermute
 from ..parallel.zigzag import zigzag_permute, zigzag_unpermute
+from ..utils.validate import check_tokens_input
 from .attention import RingAttention
 from .layers import FeedForward, RMSNorm
 
@@ -117,8 +118,18 @@ class RingTransformer(nn.Module):
         tokens: jax.Array,
         mask: jax.Array | None = None,
         return_loss: bool = False,
+        example_mask: jax.Array | None = None,
     ) -> jax.Array:
-        """``tokens: (b, n)`` int32 -> logits ``(b, n, num_tokens)`` or scalar loss."""
+        """``tokens: (b, n)`` int32 -> logits ``(b, n, num_tokens)`` or scalar loss.
+
+        ``example_mask: (b,)`` marks valid batch rows: the static-shape
+        answer to the reference's variable per-rank batch
+        (``all_gather_variable_dim``, ref ``distributed.py:58-84``,
+        exercised by ``assert_attn.py:81-82``) — ragged data-parallel
+        shards are padded to a common batch and the pad rows drop out of
+        the loss here.
+        """
+        check_tokens_input("RingTransformer", tokens)
         if return_loss:
             labels = tokens[:, 1:]
             tokens = tokens[:, :-1]
@@ -180,6 +191,8 @@ class RingTransformer(nn.Module):
 
         # Cross-entropy with ignore_index (ref ring_attention.py:664-673)
         valid = labels != self.ignore_index
+        if example_mask is not None:
+            valid = valid & example_mask[:, None]
         safe_labels = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]

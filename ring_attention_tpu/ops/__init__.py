@@ -7,9 +7,11 @@ from .flash import (
     flash_backward_blocks,
     init_carry,
 )
+from .pallas_flash import pallas_flash_attention
 from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
 
 __all__ = [
+    "pallas_flash_attention",
     "default_attention",
     "softclamp",
     "MASK_VALUE",

@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import EPSILON, MASK_VALUE
+from ..utils.validate import check_attention_args
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
@@ -771,6 +772,7 @@ def pallas_flash_attention(
     Same contract as ``ops.flash.flash_attention``; parity-tested against
     the oracle.  On non-TPU backends runs the kernels in interpreter mode.
     """
+    check_attention_args("pallas_flash_attention", q, k, v, mask)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if window is not None:

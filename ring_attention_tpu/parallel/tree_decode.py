@@ -19,6 +19,7 @@ from jax import lax
 
 from ..ops.attention import EPSILON
 from ..ops.flash import attend_blocks, init_carry, _ungroup
+from ..utils.validate import check_attention_args
 
 
 def tree_attn_decode(
@@ -46,6 +47,7 @@ def tree_attn_decode(
     Returns:
       ``(b, h, nq, d)`` decoded output, replicated across ``axis_name``.
     """
+    check_attention_args("tree_attn_decode", q, k, v, kv_mask)
     b, h, nq, d = q.shape
     hk = k.shape[1]
     g = h // hk

@@ -1,4 +1,13 @@
 from .checkpoint import restore_checkpoint, save_checkpoint
 from .profiling import StepTimer, trace
+from .validate import check_attention_args, check_model_input, check_tokens_input
 
-__all__ = ["restore_checkpoint", "save_checkpoint", "StepTimer", "trace"]
+__all__ = [
+    "restore_checkpoint",
+    "save_checkpoint",
+    "StepTimer",
+    "trace",
+    "check_attention_args",
+    "check_model_input",
+    "check_tokens_input",
+]

@@ -1,0 +1,137 @@
+"""Runtime shape/dtype validation on the public API surface.
+
+Analogue of the reference's beartype layer (ref tensor_typing.py:11-20,
+applied at ring_attention.py:47,284): malformed calls must fail fast with a
+one-line diagnostic naming the entry point, instead of erroring deep inside
+an einsum or silently computing nonsense on a transposed layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ring_attention_tpu.models import RingAttention, RingTransformer
+from ring_attention_tpu.ops import flash_attention, pallas_flash_attention
+from ring_attention_tpu.parallel import create_mesh, ring_flash_attention
+from ring_attention_tpu.parallel.tree_decode import tree_attn_decode
+from ring_attention_tpu.parallel.ulysses import ulysses_attention
+
+
+def make(shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+Q = make((2, 4, 32, 16))
+K = make((2, 4, 32, 16))
+
+
+def test_flash_rejects_3d():
+    with pytest.raises(ValueError, match=r"flash_attention: q must be 4-D"):
+        flash_attention(make((2, 32, 16)), K, K)
+
+
+def test_flash_rejects_seq_major_layout():
+    # a (b, n, h, d) kv against (b, h, n, d) q: the head axis lands on the
+    # seq slot and trips the GQA multiple check with a layout hint
+    with pytest.raises(ValueError, match=r"flash_attention: .*\(batch, seq, heads, dim\) call"):
+        flash_attention(Q, make((2, 32, 4, 16)), make((2, 32, 4, 16)))
+
+
+def test_flash_rejects_kv_shape_mismatch():
+    with pytest.raises(ValueError, match=r"k and v must have identical shapes"):
+        flash_attention(Q, K, make((2, 4, 16, 16)))
+
+
+def test_flash_rejects_bad_gqa():
+    # 3 query heads against 2 kv heads
+    with pytest.raises(ValueError, match=r"multiple of kv heads"):
+        flash_attention(make((2, 3, 32, 16)), make((2, 2, 32, 16)), make((2, 2, 32, 16)))
+
+
+def test_flash_rejects_int_dtype():
+    with pytest.raises(ValueError, match=r"q must be floating point"):
+        flash_attention(make((2, 4, 32, 16), jnp.int32), K, K)
+
+
+def test_flash_rejects_bad_mask():
+    with pytest.raises(ValueError, match=r"kv_mask must be \(batch, n_kv\)"):
+        flash_attention(Q, K, K, make((2, 16), jnp.bool_))
+
+
+def test_pallas_flash_rejects_3d():
+    with pytest.raises(ValueError, match=r"pallas_flash_attention: q must be 4-D"):
+        pallas_flash_attention(make((2, 32, 16)), K, K)
+
+
+def test_ring_rejects_bad_layout():
+    mesh = create_mesh(ring_size=8)
+    spec = P("data", None, "seq", None)
+
+    def run(q, k, v):
+        return shard_map(
+            lambda q, k, v: ring_flash_attention(q, k, v, None, "seq"),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        )(q, k, v)
+
+    with pytest.raises(ValueError, match=r"ring_flash_attention: .*disagree"):
+        run(make((2, 4, 64, 16)), make((2, 4, 64, 32)), make((2, 4, 64, 32)))
+
+
+def test_tree_decode_rejects_bad_mask():
+    mesh = create_mesh(ring_size=8)
+
+    def run():
+        qspec = P("data", None, None, None)
+        cspec = P("data", None, "seq", None)
+        return shard_map(
+            lambda q, k, v, m: tree_attn_decode(q, k, v, m, axis_name="seq"),
+            mesh=mesh,
+            in_specs=(qspec, cspec, cspec, P("data", None)),
+            out_specs=qspec,
+        )(
+            make((2, 4, 1, 16)),
+            make((2, 4, 64, 16)),
+            make((2, 4, 64, 16)),
+            make((2, 32), jnp.bool_),  # wrong: local shard is 8 slots
+        )
+
+    with pytest.raises(ValueError, match=r"tree_attn_decode: kv_mask"):
+        run()
+
+
+def test_ulysses_rejects_cross_attention():
+    mesh = create_mesh(ring_size=8)
+    spec = P("data", None, "seq", None)
+    with pytest.raises(ValueError, match=r"ulysses_attention: .*sequence length"):
+        shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, "seq"),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        )(make((2, 8, 64, 16)), make((2, 8, 128, 16)), make((2, 8, 128, 16)))
+
+
+def test_module_rejects_2d_input():
+    layer = RingAttention(dim=32, heads=4, dim_head=8)
+    with pytest.raises(ValueError, match=r"RingAttention: x must be \(batch, seq, dim=32\)"):
+        layer.init(jax.random.PRNGKey(0), make((2, 32)))
+
+
+def test_module_rejects_wrong_dim():
+    layer = RingAttention(dim=32, heads=4, dim_head=8)
+    with pytest.raises(ValueError, match=r"RingAttention: x must be"):
+        layer.init(jax.random.PRNGKey(0), make((2, 16, 64)))
+
+
+def test_transformer_rejects_float_tokens():
+    model = RingTransformer(num_tokens=64, dim=32, depth=1, causal=True)
+    with pytest.raises(ValueError, match=r"RingTransformer: tokens must be integer"):
+        model.init(jax.random.PRNGKey(0), make((2, 16), jnp.float32))
+
+
+def test_transformer_rejects_3d_tokens():
+    model = RingTransformer(num_tokens=64, dim=32, depth=1, causal=True)
+    with pytest.raises(ValueError, match=r"RingTransformer: tokens must be \(batch, seq\)"):
+        model.init(
+            jax.random.PRNGKey(0), make((2, 16, 3), jnp.int32)
+        )
