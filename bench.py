@@ -1,17 +1,21 @@
-"""Benchmark: causal flash attention throughput on one TPU chip.
+"""Benchmark: causal flash attention + train-step throughput on one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "TFLOPs/chip", "vs_baseline": N, ...}
+  {"metric": ..., "value": N, "unit": "TFLOPs/chip", "vs_baseline": N,
+   "fwdbwd_tflops": ..., "tokens_per_sec": ..., ...}
 
-North-star config (BASELINE.json): seq_len=262144, causal, 8 heads.  The
+North-star config (BASELINE.json): seq_len=262144, causal, 8 heads — both
+attention TFLOPs/chip AND tokens/sec (train step: fwd+bwd+adam).  The
 reference publishes no performance numbers (BASELINE.md), so
 ``vs_baseline`` reports the fraction of the chip's bf16 peak (MFU) —
 a hardware-grounded, round-over-round comparable scalar.
 
-Robustness: each (impl, seq_len) attempt runs in its own subprocess with a
-hard timeout (TPU compiles through this image's remote-compile relay can
-take minutes or hang), falling back to smaller lengths and the pure-XLA
-path; the parent never initializes the TPU and always prints a JSON line.
+Measurement hygiene: seeded random inputs (degenerate softmax rows on
+constant inputs can distort timing), compile time recorded separately from
+step time, per-attempt subprocess isolation with hard timeouts (TPU
+compiles through this image's remote-compile relay can take minutes or
+hang), and a quick-guarantee + target-first ladder so the parent never
+fails to print a JSON line.
 """
 
 from __future__ import annotations
@@ -36,44 +40,83 @@ PEAK_TFLOPS = {
     "v6e": 918.0,
 }
 
+# attention FLOPs: 2 matmuls fwd; bwd recomputes scores + 4 grad matmuls
+# (dv, dp, dq, dk) => 2.5x fwd; causal halves the work
+FWD_MATMULS = 2
+FWDBWD_MATMULS = 7
 
-def _worker(impl: str, seq_len: int) -> None:
-    """Runs one timed measurement and prints its own JSON line."""
-    import jax
-    import jax.numpy as jnp
+
+def _attn_fn(impl: str, seq_len: int):
     from functools import partial
-
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "").lower()
-    peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
-
-    q = jnp.ones((1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
-    k = jnp.ones((1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
-    v = jnp.ones((1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
 
     if impl == "pallas":
         from ring_attention_tpu.ops.pallas_flash import pallas_flash_attention
 
-        fn = jax.jit(partial(pallas_flash_attention, causal=True))
-    else:
-        from ring_attention_tpu.ops.flash import flash_attention
+        return partial(pallas_flash_attention, causal=True)
+    from ring_attention_tpu.ops.flash import flash_attention
 
-        bucket = min(1024, seq_len)
-        qc = 2048 if seq_len > 2048 else None  # two-level blocking for memory
-        fn = jax.jit(partial(flash_attention, causal=True, bucket_size=bucket,
-                             q_chunk_size=qc))
+    bucket = min(1024, seq_len)
+    qc = 2048 if seq_len > 2048 else None  # two-level blocking for memory
+    return partial(
+        flash_attention, causal=True, bucket_size=bucket, q_chunk_size=qc
+    )
 
-    out = fn(q, k, v)
+
+def _device_peak():
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
+    return dev, peak
+
+
+def _timed(fn, args, iters):
+    """(compile_s, step_s): first call separately, then a timed loop."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args)
     jax.block_until_ready(out)
-    iters = 3 if seq_len >= TARGET_SEQ else 10
+    compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(q, k, v)
+        out = fn(*args)
     jax.block_until_ready(out)
-    secs = (time.perf_counter() - t0) / iters
+    return compile_s, (time.perf_counter() - t0) / iters
 
-    # causal fwd FLOPs: 2 matmuls x 2 flops x n^2 x h x d x 1/2
-    flops = 2 * 2 * seq_len * seq_len * HEADS * DIM_HEAD * 0.5
+
+def _worker(impl: str, seq_len: int, mode: str) -> None:
+    """Runs one timed measurement and prints its own JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    if mode == "train":
+        _train_worker(impl, seq_len)
+        return
+
+    dev, peak = _device_peak()
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (1, HEADS, seq_len, DIM_HEAD)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+
+    attn = _attn_fn(impl, seq_len)
+    if mode == "fwdbwd":
+        fn = jax.jit(
+            jax.grad(
+                lambda q, k, v: attn(q, k, v).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+        matmuls = FWDBWD_MATMULS
+    else:
+        fn = jax.jit(attn)
+        matmuls = FWD_MATMULS
+
+    iters = 3 if seq_len >= TARGET_SEQ else 10
+    compile_s, secs = _timed(fn, (q, k, v), iters)
+
+    flops = matmuls * 2 * seq_len * seq_len * HEADS * DIM_HEAD * 0.5  # causal
     tflops = flops / secs / 1e12
     print(
         json.dumps(
@@ -84,14 +127,106 @@ def _worker(impl: str, seq_len: int) -> None:
                 "impl": impl,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
+                "compile_s": round(compile_s, 1),
             }
         )
     )
 
 
+def _train_worker(impl: str, seq_len: int) -> None:
+    """Full train step (fwd+bwd+adam) tokens/sec on one chip."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ring_attention_tpu.models import RingTransformer
+
+    dev, _ = _device_peak()
+    model = RingTransformer(
+        num_tokens=256,
+        dim=512,
+        depth=2,
+        causal=True,
+        heads=HEADS,
+        dim_head=DIM_HEAD,
+        bucket_size=2048,
+        rotary=True,
+        use_pallas=(impl == "pallas"),
+        remat=True,
+        dtype=jnp.bfloat16,
+    )
+    # params are seq-independent: init on a short sequence to keep init cheap
+    init_tokens = jnp.zeros((1, 129), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), init_tokens, return_loss=True)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, seq_len + 1), 0, 256, jnp.int32
+    )
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.apply(p, tokens, return_loss=True)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    iters = 3 if seq_len >= 65536 else 5
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    secs = (time.perf_counter() - t0) / iters
+
+    print(
+        json.dumps(
+            {
+                "tokens_per_sec": round(seq_len / secs),
+                "train_seq_len": seq_len,
+                "train_impl": impl,
+                "train_ms_per_step": round(secs * 1e3, 2),
+                "train_compile_s": round(compile_s, 1),
+                "train_loss": round(float(loss), 4),
+                "device": getattr(dev, "device_kind", str(dev)),
+            }
+        )
+    )
+
+
+def _run_attempt(impl: str, seq: int, mode: str, budget: float):
+    """Subprocess-isolated measurement; returns parsed dict or error string."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--worker", impl, str(seq), mode,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1]), None
+        return None, f"{mode}:{impl}@{seq}: rc={proc.returncode} {proc.stderr[-200:]}"
+    except subprocess.TimeoutExpired:
+        return None, f"{mode}:{impl}@{seq}: timeout"
+    except Exception:
+        return None, f"{mode}:{impl}@{seq}: {traceback.format_exc(limit=1)}"
+
+
 def main() -> None:
     result = {
-        "metric": f"causal flash attention fwd TFLOPs/chip (h={HEADS}, d={DIM_HEAD}, bf16)",
+        "metric": (
+            f"causal flash attention fwd TFLOPs/chip + train tokens/sec "
+            f"(h={HEADS}, d={DIM_HEAD}, bf16)"
+        ),
         "value": 0.0,
         "unit": "TFLOPs/chip",
         "vs_baseline": 0.0,
@@ -112,60 +247,92 @@ def main() -> None:
         print(json.dumps(result))
         return
 
-    # strategy: one quick config first (guarantees a real measurement), then
-    # the north-star config directly; intermediate sizes only as fallbacks
-    # if the target fails.  Later successes upgrade the reported number.
+    deadline = time.monotonic() + float(os.environ.get("BENCH_BUDGET_S", 3600))
+    log = []
+
+    def budget_left(need: float) -> bool:
+        return deadline - time.monotonic() >= need / 3
+
+    # phase 1 — forward TFLOPs: one quick config first (guarantees a real
+    # measurement), then the north-star config directly; intermediate sizes
+    # only as fallbacks if the target fails.
     attempts = [
         ("xla", 8192, 420, False),
         ("pallas", TARGET_SEQ, 1500, False),
         ("pallas", 65536, 900, True),   # fallback-only
         ("pallas", 16384, 600, True),   # fallback-only
     ]
-    deadline = time.monotonic() + float(os.environ.get("BENCH_BUDGET_S", 3600))
-    log = []
+    best = None  # (impl, seq) of the best successful fwd run
     got_target = False
     got_fallback = False
-    got_any = False
     for impl, seq, budget, fallback_only in attempts:
         # fallbacks are ordered largest-first: stop after the first success
         # so a smaller one never overwrites it
         if fallback_only and (got_target or got_fallback):
             continue
-        remaining = deadline - time.monotonic()
-        if remaining < budget / 3:
-            log.append(f"{impl}@{seq}: skipped (budget exhausted)")
+        if not budget_left(budget):
+            log.append(f"fwd:{impl}@{seq}: skipped (budget exhausted)")
             continue
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker", impl, str(seq)],
-                capture_output=True,
-                text=True,
-                timeout=min(budget, remaining),
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-            if proc.returncode == 0:
-                line = proc.stdout.strip().splitlines()[-1]
-                result.update(json.loads(line))
-                got_any = True
-                got_target = got_target or seq == TARGET_SEQ
-                got_fallback = got_fallback or fallback_only
-                log.append(f"{impl}@{seq}: ok")
+        payload, err = _run_attempt(
+            impl, seq, "fwd", min(budget, deadline - time.monotonic())
+        )
+        if payload is None:
+            log.append(err)
+            continue
+        result.update(payload)
+        best = (impl, seq)
+        got_target = got_target or seq == TARGET_SEQ
+        got_fallback = got_fallback or fallback_only
+        log.append(f"fwd:{impl}@{seq}: ok")
+
+    # phase 2 — fwd+bwd TFLOPs at the best forward config (bwd timing is
+    # half the north-star training story; BASELINE.md)
+    if best is not None and budget_left(900):
+        impl, seq = best
+        payload, err = _run_attempt(
+            impl, seq, "fwdbwd", min(900, deadline - time.monotonic())
+        )
+        if payload is not None:
+            result["fwdbwd_tflops"] = payload["value"]
+            result["fwdbwd_ms_per_step"] = payload["ms_per_step"]
+            result["fwdbwd_compile_s"] = payload["compile_s"]
+            log.append(f"fwdbwd:{impl}@{seq}: ok")
+        else:
+            log.append(err)
+
+    # phase 3 — train-step tokens/sec (fwd+bwd+adam), largest seq that fits
+    if best is not None:
+        impl = best[0]
+        train_seqs = []
+        for s in (best[1], best[1] // 4, 8192):
+            if s >= 1024 and s not in train_seqs:
+                train_seqs.append(s)
+        for seq in train_seqs:
+            if "tokens_per_sec" in result:
+                break
+            if not budget_left(1200):
+                log.append(f"train:{impl}@{seq}: skipped (budget exhausted)")
                 continue
-            log.append(f"{impl}@{seq}: rc={proc.returncode} {proc.stderr[-200:]}")
-        except subprocess.TimeoutExpired:
-            log.append(f"{impl}@{seq}: timeout")
-        except Exception:
-            log.append(f"{impl}@{seq}: {traceback.format_exc(limit=1)}")
+            payload, err = _run_attempt(
+                impl, seq, "train", min(1200, deadline - time.monotonic())
+            )
+            if payload is not None:
+                result.update(payload)
+                log.append(f"train:{impl}@{seq}: ok")
+            else:
+                log.append(err)
+
     # keep the attempt trail even on success so a fallback-sized result is
     # never mistaken for a clean north-star run round-over-round
-    result["attempts"] = " | ".join(log)[-500:]
-    if not got_any:
+    result["attempts"] = " | ".join(log)[-600:]
+    if best is None:
         result["error"] = result["attempts"]
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        _worker(sys.argv[2], int(sys.argv[3]))
+        mode = sys.argv[4] if len(sys.argv) > 4 else "fwd"
+        _worker(sys.argv[2], int(sys.argv[3]), mode)
     else:
         main()

@@ -29,20 +29,43 @@ def test_dryrun_multichip_8():
     g.dryrun_multichip(8)  # raises on any failure
 
 
-def test_bench_worker_contract():
-    """bench.py --worker prints one parseable JSON measurement line."""
+def _run_bench_worker(args, timeout=300):
     import json
     import subprocess
 
     bench_path = os.path.join(REPO_ROOT, "bench.py")
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu');"
-        "import sys; sys.argv = ['bench.py', '--worker', 'xla', '1024'];"
+        f"import sys; sys.argv = {['bench.py', '--worker'] + args!r};"
         f"exec(open({bench_path!r}).read())"
     )
     proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout,
     )
     assert proc.returncode == 0, proc.stderr[-500:]
-    rec = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert {"value", "vs_baseline", "seq_len", "impl"} <= set(rec)
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_bench_worker_contract():
+    """bench.py --worker prints one parseable JSON measurement line, with
+    compile time recorded separately from step time."""
+    rec = _run_bench_worker(["xla", "1024", "fwd"])
+    assert {"value", "vs_baseline", "seq_len", "impl", "compile_s"} <= set(rec)
+
+
+def test_bench_worker_fwdbwd():
+    """Backward-included attention timing (the other half of the
+    north-star: BASELINE.md wants fwd AND training-relevant numbers)."""
+    rec = _run_bench_worker(["xla", "1024", "fwdbwd"])
+    assert rec["value"] > 0 and rec["ms_per_step"] > 0
+
+
+def test_bench_worker_train():
+    """Train-step (fwd+bwd+adam) tokens/sec measurement."""
+    rec = _run_bench_worker(["xla", "1024", "train"], timeout=600)
+    assert rec["tokens_per_sec"] > 0
+    assert rec["train_seq_len"] == 1024
+    import math
+
+    assert math.isfinite(rec["train_loss"])
