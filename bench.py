@@ -16,6 +16,14 @@ step time, per-attempt subprocess isolation with hard timeouts (TPU
 compiles through this image's remote-compile relay can take minutes or
 hang), and a quick-guarantee + target-first ladder so the parent never
 fails to print a JSON line.
+
+Relay-aware timing: through this image's axon TPU tunnel,
+``block_until_ready`` returns immediately and independently-enqueued
+executions can complete out of order — both standard timing idioms
+report fiction.  Each measurement is therefore a single jitted
+``lax.scan`` whose iterations are chained by a data dependency, synced by
+fetching a scalar, with the separately-measured fetch round-trip
+subtracted.
 """
 
 from __future__ import annotations
@@ -71,19 +79,43 @@ def _device_peak():
     return dev, peak
 
 
-def _timed(fn, args, iters):
-    """(compile_s, step_s): first call separately, then a timed loop."""
+def _fetch_rtt(samples: int = 3):
+    """Host<->device scalar fetch round trip (subtracted from timings).
+    Min of several samples: the relay's RTT is noisy and one spike must
+    not eat a whole measurement."""
     import jax
+    import jax.numpy as jnp
 
+    f = jax.jit(lambda x: x + 1)
+    _ = float(f(jnp.float32(0)))
+    best = float("inf")
+    for i in range(samples):
+        t0 = time.perf_counter()
+        _ = float(f(jnp.float32(i)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _timed(chained_fn, args, iters):
+    """(compile_s, step_s) for ``chained_fn``: a jitted function running
+    ``iters`` data-dependent iterations on-device and returning a scalar.
+    Raises if the measurement is smaller than the fetch round trip —
+    a nonsense number must not reach the bench JSON."""
     t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    compile_s = time.perf_counter() - t0
+    _ = float(chained_fn(*args))
+    first_total = time.perf_counter() - t0
+    rtt = _fetch_rtt()
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return compile_s, (time.perf_counter() - t0) / iters
+    _ = float(chained_fn(*args))
+    total = time.perf_counter() - t0
+    if total <= rtt:
+        raise RuntimeError(
+            f"measurement ({total*1e3:.1f} ms) not above fetch RTT "
+            f"({rtt*1e3:.1f} ms); increase iters"
+        )
+    # first call = compile + one full execution of the chain
+    compile_s = max(first_total - total, 0.0)
+    return compile_s, (total - rtt) / iters
 
 
 def _worker(impl: str, seq_len: int, mode: str) -> None:
@@ -101,20 +133,42 @@ def _worker(impl: str, seq_len: int, mode: str) -> None:
     q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
 
     attn = _attn_fn(impl, seq_len)
+    iters = 3 if seq_len >= TARGET_SEQ else 10
+
     if mode == "fwdbwd":
-        fn = jax.jit(
-            jax.grad(
-                lambda q, k, v: attn(q, k, v).astype(jnp.float32).sum(),
-                argnums=(0, 1, 2),
-            )
+        grad_fn = jax.grad(
+            lambda q, k, v: attn(q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
         )
+
+        @jax.jit
+        def chained(q, k, v):
+            def body(carry, _):
+                dq, dk, dv = grad_fn(carry, k, v)
+                # chain through all three grads so none is dead code
+                nxt = (carry + 1e-6 * dq.astype(carry.dtype)
+                       + (dk.mean() + dv.mean()).astype(carry.dtype) * 1e-9)
+                return nxt, dq[0, 0, 0, 0]
+            out, ys = jax.lax.scan(body, q, None, length=iters)
+            return ys.sum()
+
         matmuls = FWDBWD_MATMULS
     else:
-        fn = jax.jit(attn)
+
+        @jax.jit
+        def chained(q, k, v):
+            def body(carry, _):
+                o = attn(carry, k, v)
+                # perturb rather than replace: feeding o back as q would
+                # collapse score variance into the degenerate-softmax
+                # regime the seeded inputs exist to avoid
+                return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+            out, ys = jax.lax.scan(body, q, None, length=iters)
+            return ys.astype(jnp.float32).sum()
+
         matmuls = FWD_MATMULS
 
-    iters = 3 if seq_len >= TARGET_SEQ else 10
-    compile_s, secs = _timed(fn, (q, k, v), iters)
+    compile_s, secs = _timed(chained, (q, k, v), iters)
 
     flops = matmuls * 2 * seq_len * seq_len * HEADS * DIM_HEAD * 0.5  # causal
     tflops = flops / secs / 1e12
@@ -165,7 +219,6 @@ def _train_worker(impl: str, seq_len: int) -> None:
         jax.random.PRNGKey(1), (1, seq_len + 1), 0, 256, jnp.int32
     )
 
-    @jax.jit
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
             lambda p: model.apply(p, tokens, return_loss=True)
@@ -174,15 +227,30 @@ def _train_worker(impl: str, seq_len: int) -> None:
         return optax.apply_updates(params, updates), opt_state, loss
 
     iters = 3 if seq_len >= 65536 else 5
+
+    @jax.jit
+    def chained(params, opt_state, tokens):
+        def body(carry, _):
+            params, opt_state = carry
+            params, opt_state, loss = step(params, opt_state, tokens)
+            return (params, opt_state), loss
+        _, losses = jax.lax.scan(body, (params, opt_state), None, length=iters)
+        return losses[-1]
+
     t0 = time.perf_counter()
-    params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
-    compile_s = time.perf_counter() - t0
+    loss = float(chained(params, opt_state, tokens))
+    first_total = time.perf_counter() - t0
+    rtt = _fetch_rtt()
     t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
-    secs = (time.perf_counter() - t0) / iters
+    loss = float(chained(params, opt_state, tokens))
+    total = time.perf_counter() - t0
+    if total <= rtt:
+        raise RuntimeError(
+            f"train measurement ({total*1e3:.1f} ms) not above fetch RTT "
+            f"({rtt*1e3:.1f} ms); increase iters"
+        )
+    compile_s = max(first_total - total, 0.0)
+    secs = (total - rtt) / iters
 
     print(
         json.dumps(
