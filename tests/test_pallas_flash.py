@@ -147,3 +147,62 @@ def test_wide_head_dim(rng):
     )(q, k, v)
     for a, b, name in zip(g_out, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=1e-3, err_msg=f"d{name}")
+
+
+# ---------------------------------------------------------------------------
+# Compacted causal grid: with static band offsets the kernels run on a
+# flattened grid of only the active tiles (scalar-prefetched tile tables);
+# a traced offset keeps the rectangular grid.  The two grids must agree
+# bit-for-bit on every band shape, including fully-empty rows.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "co,wlo",
+    [(0, None), (-1, None), (0, -95), (-300, None)],
+    ids=["causal", "striped-flip", "window", "all-empty"],
+)
+def test_compact_grid_matches_rectangular(rng, co, wlo):
+    q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    scale = q.shape[-1] ** -0.5
+
+    static = pallas_flash_partials(
+        q, k, v, scale=scale, causal_offset=co, window_lo=wlo,
+        block_q=64, block_k=64, interpret=True,
+    )
+    traced = jax.jit(
+        lambda q, k, v, o, w: pallas_flash_partials(
+            q, k, v, scale=scale, causal_offset=o,
+            window_lo=w if wlo is not None else None,
+            block_q=64, block_k=64, interpret=True,
+        )
+    )(q, k, v, jnp.int32(co), jnp.int32(wlo if wlo is not None else 0))
+    for a, b, name in zip(static, traced, ("acc", "m", "l")):
+        np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_compact_grid_backward_matches_rectangular(rng):
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_backward
+
+    q, k, v = make_qkv(rng, b=1, h=4, hk=2, n=256, d=32)
+    do = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    parts = pallas_flash_partials(
+        q, k, v, scale=scale, causal_offset=0,
+        block_q=64, block_k=64, interpret=True,
+    )
+    out, lse = finalize_partials(parts)
+    delta = (do * out).sum(-1)
+
+    static = pallas_flash_backward(
+        do, q, k, v, lse, delta, scale=scale, causal_offset=0,
+        block_q=64, block_k=64, interpret=True,
+    )
+    traced = jax.jit(
+        lambda o: pallas_flash_backward(
+            do, q, k, v, lse, delta, scale=scale, causal_offset=o,
+            block_q=64, block_k=64, interpret=True,
+        )
+    )(jnp.int32(0))
+    for a, b, name in zip(static, traced, ("dq", "dk", "dv")):
+        np.testing.assert_array_equal(a, b, err_msg=name)
