@@ -148,6 +148,25 @@ def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
 _TF_FIRST, _TF_LAST, _TF_WORK = 1, 2, 4
 
 
+def _compact_maps(h: int, hk: int, g: int):
+    """Index maps for a compacted grid (bh, t): q-side blocks follow the
+    tile table's q entry, kv-side blocks its k entry (GQA head fold)."""
+
+    def q_map(bh, t, offs, tq, tk, tf):
+        return (bh, tq[t], 0)
+
+    def kv_map(bh, t, offs, tq, tk, tf):
+        return ((bh // h) * hk + (bh % h) // g, tk[t], 0)
+
+    def kvm_map(bh, t, offs, tq, tk, tf):
+        return (bh // h, tk[t])
+
+    def k_out_map(bh, t, offs, tq, tk, tf):
+        return (bh, tk[t], 0)
+
+    return q_map, kv_map, kvm_map, k_out_map
+
+
 def _static_band(causal, windowed, causal_offset, window_lo):
     """True when the band is known at trace time (compact grid usable)."""
     if not causal:
@@ -390,16 +409,7 @@ def pallas_flash_partials(
         )
         scalars = (offs, tq_a, tk_a, tf_a)
         grid = (b * h, tq_a.shape[0])
-
-        def q_map(bh, t, offs, tq, tk, tf):
-            return (bh, tq[t], 0)
-
-        def kv_map(bh, t, offs, tq, tk, tf):
-            return ((bh // h) * hk + (bh % h) // g, tk[t], 0)
-
-        def kvm_map(bh, t, offs, tq, tk, tf):
-            return (bh // h, tk[t])
-
+        q_map, kv_map, kvm_map, _ = _compact_maps(h, hk, g)
         kernel = functools.partial(
             _fwd_kernel_compact if masked else _fwd_kernel_compact_nomask,
             **common,
@@ -868,18 +878,7 @@ def pallas_flash_backward(
 
     # ---- dk/dv pass: grid (bh, k blocks, q blocks), or compacted band ----
     if compact:
-        def dkv_q_map(bh, t, offs, tq, tk, tf):
-            return (bh, tq[t], 0)
-
-        def dkv_kv_map(bh, t, offs, tq, tk, tf):
-            return ((bh // h) * hk + (bh % h) // g, tk[t], 0)
-
-        def dkv_kvm_map(bh, t, offs, tq, tk, tf):
-            return (bh // h, tk[t])
-
-        def dkv_out_map(bh, t, offs, tq, tk, tf):
-            return (bh, tk[t], 0)
-
+        dkv_q_map, dkv_kv_map, dkv_kvm_map, dkv_out_map = _compact_maps(h, hk, g)
         dkv_scalars = (offs, *dkv_tabs)
         dkv_grid = (b * h, dkv_tabs[0].shape[0])
         dkv_kernel = functools.partial(
@@ -948,15 +947,7 @@ def pallas_flash_backward(
 
     # ---- dq pass: grid (bh, q blocks, k blocks), or compacted band ----
     if compact:
-        def dq_q_map(bh, t, offs, tq, tk, tf):
-            return (bh, tq[t], 0)
-
-        def dq_kv_map(bh, t, offs, tq, tk, tf):
-            return ((bh // h) * hk + (bh % h) // g, tk[t], 0)
-
-        def dq_kvm_map(bh, t, offs, tq, tk, tf):
-            return (bh // h, tk[t])
-
+        dq_q_map, dq_kv_map, dq_kvm_map, _ = _compact_maps(h, hk, g)
         dq_scalars = (offs, *dq_tabs)
         dq_grid = (b * h, dq_tabs[0].shape[0])
         dq_kernel = functools.partial(

@@ -158,21 +158,28 @@ def test_wide_head_dim(rng):
 
 
 @pytest.mark.parametrize(
-    "co,wlo",
-    [(0, None), (-1, None), (0, -95), (-300, None)],
-    ids=["causal", "striped-flip", "window", "all-empty"],
+    "co,wlo,masked",
+    [
+        (0, None, False),
+        (-1, None, False),
+        (0, -95, False),
+        (-300, None, False),
+        (0, None, True),
+    ],
+    ids=["causal", "striped-flip", "window", "all-empty", "kvmask"],
 )
-def test_compact_grid_matches_rectangular(rng, co, wlo):
+def test_compact_grid_matches_rectangular(rng, co, wlo, masked):
     q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    mask = jnp.asarray(rng.random((1, 256)) > 0.3) if masked else None
     scale = q.shape[-1] ** -0.5
 
     static = pallas_flash_partials(
-        q, k, v, scale=scale, causal_offset=co, window_lo=wlo,
+        q, k, v, mask, scale=scale, causal_offset=co, window_lo=wlo,
         block_q=64, block_k=64, interpret=True,
     )
     traced = jax.jit(
         lambda q, k, v, o, w: pallas_flash_partials(
-            q, k, v, scale=scale, causal_offset=o,
+            q, k, v, mask, scale=scale, causal_offset=o,
             window_lo=w if wlo is not None else None,
             block_q=64, block_k=64, interpret=True,
         )
@@ -181,28 +188,35 @@ def test_compact_grid_matches_rectangular(rng, co, wlo):
         np.testing.assert_array_equal(a, b, err_msg=name)
 
 
-def test_compact_grid_backward_matches_rectangular(rng):
+@pytest.mark.parametrize(
+    "co,wlo,masked",
+    [(0, None, False), (0, -95, False), (0, None, True)],
+    ids=["causal", "window", "kvmask"],
+)
+def test_compact_grid_backward_matches_rectangular(rng, co, wlo, masked):
     from ring_attention_tpu.ops.pallas_flash import pallas_flash_backward
 
     q, k, v = make_qkv(rng, b=1, h=4, hk=2, n=256, d=32)
     do = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    mask = jnp.asarray(rng.random((1, 256)) > 0.3) if masked else None
     scale = q.shape[-1] ** -0.5
     parts = pallas_flash_partials(
-        q, k, v, scale=scale, causal_offset=0,
+        q, k, v, mask, scale=scale, causal_offset=co, window_lo=wlo,
         block_q=64, block_k=64, interpret=True,
     )
     out, lse = finalize_partials(parts)
     delta = (do * out).sum(-1)
 
     static = pallas_flash_backward(
-        do, q, k, v, lse, delta, scale=scale, causal_offset=0,
-        block_q=64, block_k=64, interpret=True,
+        do, q, k, v, lse, delta, mask, scale=scale, causal_offset=co,
+        window_lo=wlo, block_q=64, block_k=64, interpret=True,
     )
     traced = jax.jit(
-        lambda o: pallas_flash_backward(
-            do, q, k, v, lse, delta, scale=scale, causal_offset=o,
+        lambda o, w: pallas_flash_backward(
+            do, q, k, v, lse, delta, mask, scale=scale, causal_offset=o,
+            window_lo=w if wlo is not None else None,
             block_q=64, block_k=64, interpret=True,
         )
-    )(jnp.int32(0))
+    )(jnp.int32(co), jnp.int32(wlo if wlo is not None else 0))
     for a, b, name in zip(static, traced, ("dq", "dk", "dv")):
         np.testing.assert_array_equal(a, b, err_msg=name)
