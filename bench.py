@@ -80,42 +80,15 @@ def _device_peak():
 
 
 def _fetch_rtt(samples: int = 3):
-    """Host<->device scalar fetch round trip (subtracted from timings).
-    Min of several samples: the relay's RTT is noisy and one spike must
-    not eat a whole measurement."""
-    import jax
-    import jax.numpy as jnp
+    from ring_attention_tpu.utils.benchtime import fetch_rtt
 
-    f = jax.jit(lambda x: x + 1)
-    _ = float(f(jnp.float32(0)))
-    best = float("inf")
-    for i in range(samples):
-        t0 = time.perf_counter()
-        _ = float(f(jnp.float32(i)))
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return fetch_rtt(samples)
 
 
 def _timed(chained_fn, args, iters):
-    """(compile_s, step_s) for ``chained_fn``: a jitted function running
-    ``iters`` data-dependent iterations on-device and returning a scalar.
-    Raises if the measurement is smaller than the fetch round trip —
-    a nonsense number must not reach the bench JSON."""
-    t0 = time.perf_counter()
-    _ = float(chained_fn(*args))
-    first_total = time.perf_counter() - t0
-    rtt = _fetch_rtt()
-    t0 = time.perf_counter()
-    _ = float(chained_fn(*args))
-    total = time.perf_counter() - t0
-    if total <= rtt:
-        raise RuntimeError(
-            f"measurement ({total*1e3:.1f} ms) not above fetch RTT "
-            f"({rtt*1e3:.1f} ms); increase iters"
-        )
-    # first call = compile + one full execution of the chain
-    compile_s = max(first_total - total, 0.0)
-    return compile_s, (total - rtt) / iters
+    from ring_attention_tpu.utils.benchtime import timed_chained
+
+    return timed_chained(chained_fn, args, iters)
 
 
 def _worker(impl: str, seq_len: int, mode: str) -> None:
@@ -237,20 +210,11 @@ def _train_worker(impl: str, seq_len: int) -> None:
         _, losses = jax.lax.scan(body, (params, opt_state), None, length=iters)
         return losses[-1]
 
-    t0 = time.perf_counter()
-    loss = float(chained(params, opt_state, tokens))
-    first_total = time.perf_counter() - t0
-    rtt = _fetch_rtt()
-    t0 = time.perf_counter()
-    loss = float(chained(params, opt_state, tokens))
-    total = time.perf_counter() - t0
-    if total <= rtt:
-        raise RuntimeError(
-            f"train measurement ({total*1e3:.1f} ms) not above fetch RTT "
-            f"({rtt*1e3:.1f} ms); increase iters"
-        )
-    compile_s = max(first_total - total, 0.0)
-    secs = (total - rtt) / iters
+    from ring_attention_tpu.utils.benchtime import timed_chained
+
+    compile_s, secs, loss = timed_chained(
+        chained, (params, opt_state, tokens), iters, return_value=True
+    )
 
     print(
         json.dumps(

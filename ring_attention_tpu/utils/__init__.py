@@ -1,5 +1,6 @@
 from .checkpoint import restore_checkpoint, save_checkpoint
 from .profiling import StepTimer, trace
+from .benchtime import fetch_rtt, timed_chained
 from .validate import check_attention_args, check_model_input, check_tokens_input
 
 __all__ = [
@@ -10,4 +11,6 @@ __all__ = [
     "check_attention_args",
     "check_model_input",
     "check_tokens_input",
+    "fetch_rtt",
+    "timed_chained",
 ]

@@ -1,0 +1,75 @@
+"""Honest wall-clock timing under async dispatch.
+
+JAX dispatch is asynchronous, and some transports (this image's TPU
+tunnel among them) additionally make ``jax.block_until_ready`` a no-op
+and let independently-enqueued executions complete out of order.  Any
+timing loop built on ``block_until_ready`` can then report numbers that
+are hundreds of times the hardware peak.  The only measurement that
+survives such a transport is:
+
+1. run all iterations *inside one executable*, chained by a real data
+   dependency (``lax.scan`` whose carry feeds the next step),
+2. synchronize by fetching a scalar derived from the result (a value
+   fetch must round-trip), and
+3. subtract the separately measured fetch round trip (min of several
+   samples, so one latency spike cannot eat the measurement).
+
+These helpers implement that recipe; ``bench.py`` builds on them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+__all__ = ["fetch_rtt", "timed_chained"]
+
+
+def fetch_rtt(samples: int = 3) -> float:
+    """Seconds for one host<->device scalar fetch (min over ``samples``)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    _ = float(f(jnp.float32(0)))  # compile outside the timed region
+    best = float("inf")
+    for i in range(samples):
+        t0 = time.perf_counter()
+        _ = float(f(jnp.float32(i)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed_chained(
+    chained_fn: Callable[..., object],
+    args: Sequence[object],
+    iters: int,
+    *,
+    return_value: bool = False,
+) -> tuple[float, float] | tuple[float, float, float]:
+    """(compile_seconds, seconds_per_iteration[, value]) for a chained run.
+
+    ``chained_fn`` must be a jitted callable that runs ``iters``
+    data-dependent iterations on device and returns a scalar (convertible
+    with ``float``); with ``return_value=True`` that scalar is returned
+    too.  Raises ``RuntimeError`` if the measured time is not above the
+    fetch round trip — a nonsense number is worse than no number.
+    """
+    t0 = time.perf_counter()
+    _ = float(chained_fn(*args))
+    first_total = time.perf_counter() - t0
+    rtt = fetch_rtt()
+    t0 = time.perf_counter()
+    value = float(chained_fn(*args))
+    total = time.perf_counter() - t0
+    if total <= rtt:
+        raise RuntimeError(
+            f"measurement ({total * 1e3:.1f} ms) not above fetch RTT "
+            f"({rtt * 1e3:.1f} ms); increase iters"
+        )
+    # the first call is compile + one full execution of the chain
+    compile_s = max(first_total - total, 0.0)
+    per_iter = (total - rtt) / iters
+    if return_value:
+        return compile_s, per_iter, value
+    return compile_s, per_iter

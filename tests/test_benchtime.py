@@ -1,0 +1,42 @@
+"""utils.benchtime: chained timing must produce sane, positive numbers."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ring_attention_tpu.utils.benchtime import fetch_rtt, timed_chained
+
+
+def test_fetch_rtt_positive():
+    rtt = fetch_rtt(samples=2)
+    assert 0 < rtt < 60
+
+
+def test_timed_chained_measures_work():
+    iters = 4
+
+    @jax.jit
+    def chained(x):
+        def body(c, _):
+            c = jnp.tanh(c @ c) + c
+            return c, c[0, 0]
+        _, ys = jax.lax.scan(body, x, None, length=iters)
+        return ys.sum()
+
+    x = jnp.eye(512) * 0.1
+    compile_s, per_iter = timed_chained(chained, (x,), iters)
+    assert compile_s >= 0
+    assert per_iter > 0
+
+
+def test_timed_chained_rejects_sub_rtt_measurement(monkeypatch):
+    import ring_attention_tpu.utils.benchtime as bt
+
+    monkeypatch.setattr(bt, "fetch_rtt", lambda samples=3: 1e6)
+
+    @jax.jit
+    def trivial(x):
+        return x + 1
+
+    with pytest.raises(RuntimeError, match="RTT"):
+        bt.timed_chained(trivial, (jnp.float32(1),), iters=1)
