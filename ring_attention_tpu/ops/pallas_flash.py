@@ -800,14 +800,32 @@ def pallas_flash_backward(
     softclamp_value: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    block_q_dkv: int | None = None,
+    block_k_dkv: int | None = None,
+    block_q_dq: int | None = None,
+    block_k_dq: int | None = None,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-pass flash backward. Returns (dq, dk, dv), all f32, dk/dv with
-    ``hk`` heads (GQA group-summed)."""
+    ``hk`` heads (GQA group-summed).
+
+    The two passes stream in opposite directions (dk/dv holds KV and
+    streams queries; dq holds Q and streams KV), so their optimal tile
+    shapes differ; ``block_*_dkv`` / ``block_*_dq`` override the shared
+    ``block_q`` / ``block_k`` per pass."""
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
-    bq, bk = _block_sizes(nq, nk, block_q, block_k)
+    bq1, bk1 = _block_sizes(
+        nq, nk,
+        block_q_dkv if block_q_dkv is not None else block_q,
+        block_k_dkv if block_k_dkv is not None else block_k,
+    )
+    bq2, bk2 = _block_sizes(
+        nq, nk,
+        block_q_dq if block_q_dq is not None else block_q,
+        block_k_dq if block_k_dq is not None else block_k,
+    )
     interpret = _interpret_default() if interpret is None else interpret
 
     causal = causal_offset is not None
@@ -823,12 +841,12 @@ def pallas_flash_backward(
         lo = int(window_lo) if windowed else 0
         dkv_tabs = [
             jnp.asarray(t)
-            for t in _band_tables(nq // bq, nk // bk, bq, bk, hi, lo,
+            for t in _band_tables(nq // bq1, nk // bk1, bq1, bk1, hi, lo,
                                   windowed, outer_is_q=False)
         ]
         dq_tabs = [
             jnp.asarray(t)
-            for t in _band_tables(nq // bq, nk // bk, bq, bk, hi, lo,
+            for t in _band_tables(nq // bq2, nk // bk2, bq2, bk2, hi, lo,
                                   windowed, outer_is_q=True)
         ]
         unified = _unify_vma(
@@ -866,15 +884,16 @@ def pallas_flash_backward(
         kvh = (bh % h) // g
         return (b_idx * hk + kvh, ki, 0)
 
-    common = dict(
+    common1 = dict(
         scale=scale,
         softclamp_value=softclamp_value,
         causal=causal,
         windowed=windowed,
         masked=masked,
-        bq=bq,
-        bk=bk,
+        bq=bq1,
+        bk=bk1,
     )
+    common2 = dict(common1, bq=bq2, bk=bk2)
 
     # ---- dk/dv pass: grid (bh, k blocks, q blocks), or compacted band ----
     if compact:
@@ -883,7 +902,7 @@ def pallas_flash_backward(
         dkv_grid = (b * h, dkv_tabs[0].shape[0])
         dkv_kernel = functools.partial(
             _bwd_dkv_kernel_compact if masked else _bwd_dkv_kernel_compact_nomask,
-            **common,
+            **common1,
         )
         dkv_semantics = ("parallel", "arbitrary")
     else:
@@ -892,27 +911,27 @@ def pallas_flash_backward(
         dkv_kvm_map = lambda bh, ki, qi, *_: (bh // h, ki)  # noqa: E731
         dkv_out_map = lambda bh, ki, qi, *_: (bh, ki, 0)  # noqa: E731
         dkv_scalars = (offs,)
-        dkv_grid = (b * h, nk // bk, nq // bq)
+        dkv_grid = (b * h, nk // bk1, nq // bq1)
         dkv_kernel = functools.partial(
             _bwd_dkv_kernel if masked else _bwd_dkv_kernel_nomask,
-            nq_blocks=nq // bq,
-            **common,
+            nq_blocks=nq // bq1,
+            **common1,
         )
         dkv_semantics = ("parallel", "parallel", "arbitrary")
 
     in_specs = [
-        pl.BlockSpec((1, bq, d), dkv_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bq, d), dkv_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bq, 1), dkv_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bq, 1), dkv_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), dkv_kv_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), dkv_kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq1, d), dkv_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq1, d), dkv_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq1, 1), dkv_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq1, 1), dkv_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk1, d), dkv_kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk1, d), dkv_kv_map, memory_space=pltpu.VMEM),
     ]
     inputs = [qr, dor, lser, deltar, kr, vr]
     if masked:
         kvm = kv_mask.astype(jnp.int8)
         in_specs.append(
-            pl.BlockSpec((1, bk), dkv_kvm_map, memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, bk1), dkv_kvm_map, memory_space=pltpu.VMEM)
         )
         inputs.append(kvm)
 
@@ -923,12 +942,12 @@ def pallas_flash_backward(
             grid=dkv_grid,
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, bk, d), dkv_out_map, memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bk, d), dkv_out_map, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk1, d), dkv_out_map, memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk1, d), dkv_out_map, memory_space=pltpu.VMEM),
             ],
             scratch_shapes=[
-                pltpu.VMEM((bk, d), jnp.float32),
-                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk1, d), jnp.float32),
+                pltpu.VMEM((bk1, d), jnp.float32),
             ],
         ),
         out_shape=[
@@ -952,7 +971,7 @@ def pallas_flash_backward(
         dq_grid = (b * h, dq_tabs[0].shape[0])
         dq_kernel = functools.partial(
             _bwd_dq_kernel_compact if masked else _bwd_dq_kernel_compact_nomask,
-            **common,
+            **common2,
         )
         dq_semantics = ("parallel", "arbitrary")
     else:
@@ -960,27 +979,27 @@ def pallas_flash_backward(
         dq_kv_map = kv_map_inner
         dq_kvm_map = lambda bh, qi, ki, *_: (bh // h, ki)  # noqa: E731
         dq_scalars = (offs,)
-        dq_grid = (b * h, nq // bq, nk // bk)
+        dq_grid = (b * h, nq // bq2, nk // bk2)
         dq_kernel = functools.partial(
             _bwd_dq_kernel if masked else _bwd_dq_kernel_nomask,
-            nk_blocks=nk // bk,
-            **common,
+            nk_blocks=nk // bk2,
+            **common2,
         )
         dq_semantics = ("parallel", "parallel", "arbitrary")
 
     in_specs = [
-        pl.BlockSpec((1, bq, d), dq_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bq, d), dq_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bq, 1), dq_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bq, 1), dq_q_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), dq_kv_map, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), dq_kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq2, d), dq_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq2, d), dq_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq2, 1), dq_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq2, 1), dq_q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk2, d), dq_kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk2, d), dq_kv_map, memory_space=pltpu.VMEM),
     ]
     inputs = [qr, dor, lser, deltar, kr, vr]
     if masked:
         inputs.append(kvm)
         in_specs.append(
-            pl.BlockSpec((1, bk), dq_kvm_map, memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, bk2), dq_kvm_map, memory_space=pltpu.VMEM)
         )
 
     dq = pl.pallas_call(
@@ -989,8 +1008,8 @@ def pallas_flash_backward(
             num_scalar_prefetch=len(dq_scalars),
             grid=dq_grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, bq, d), dq_q_map, memory_space=pltpu.VMEM),
-            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            out_specs=pl.BlockSpec((1, bq2, d), dq_q_map, memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((bq2, d), jnp.float32)],
         ),
         out_shape=_sds((b * h, nq, d), jnp.float32, q),
         compiler_params=pltpu.CompilerParams(

@@ -220,3 +220,37 @@ def test_compact_grid_backward_matches_rectangular(rng, co, wlo, masked):
     )(jnp.int32(co), jnp.int32(wlo if wlo is not None else 0))
     for a, b, name in zip(static, traced, ("dq", "dk", "dv")):
         np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+@pytest.mark.parametrize(
+    "traced,masked", [(False, False), (True, False), (True, True)],
+    ids=["compact", "rectangular", "rectangular-masked"],
+)
+def test_backward_per_pass_block_sizes(rng, traced, masked):
+    """dkv and dq passes accept independent tile shapes on both grids."""
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_backward
+
+    q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    do = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    mask = jnp.asarray(rng.random((1, 256)) > 0.3) if masked else None
+    scale = q.shape[-1] ** -0.5
+    parts = pallas_flash_partials(
+        q, k, v, mask, scale=scale, causal_offset=0,
+        block_q=64, block_k=64, interpret=True,
+    )
+    out, lse = finalize_partials(parts)
+    delta = (do * out).sum(-1)
+
+    def run(**blocks):
+        co = jnp.int32(0) if traced else 0
+        f = lambda c: pallas_flash_backward(  # noqa: E731
+            do, q, k, v, lse, delta, mask, scale=scale, causal_offset=c,
+            interpret=True, **blocks,
+        )
+        return jax.jit(f)(co) if traced else f(co)
+
+    base = run(block_q=64, block_k=64)
+    split = run(block_q_dkv=32, block_k_dkv=128,
+                block_q_dq=128, block_k_dq=32)
+    for a, b, name in zip(base, split, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(a, b, atol=1e-4, err_msg=name)
