@@ -42,9 +42,10 @@ from jax.experimental.pallas import tpu as pltpu
 from .attention import EPSILON, MASK_VALUE
 from ..utils.validate import check_attention_args
 
-# Tuned on TPU v5e (seq 262144, h=8, d=64, bf16, causal): 1024x1024 won the
-# sweep at 57.7 fwd TFLOPs/chip; >=16MB f32 score tiles (2048x2048, 1024x4096)
-# are rejected by Mosaic on this generation.
+# Tuned on TPU v5e (seq 262144, h=8, d=64, bf16, causal): 1024x1024 won both
+# sweeps — 57.7 fwd TFLOPs/chip on the rectangular grid, 67.6 with the
+# compacted causal grid (docs/hardware_log.md); >=16MB f32 score tiles
+# (2048x2048, 1024x4096) are rejected by Mosaic on this generation.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
