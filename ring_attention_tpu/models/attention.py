@@ -70,6 +70,10 @@ class RingAttention(nn.Module):
     #   "zigzag"  — Llama-3 chunk pairing + all-gathered KV (causal only)
     #   "ulysses" — all-to-all head parallelism (not in the reference)
     sequence_parallel: str = "ring"
+    # circulate KV halves in opposite ring directions (full-duplex ICI);
+    # applies when the local shard length is even, silently unidirectional
+    # otherwise (odd shards only arise from padding edge cases)
+    ring_bidirectional: bool = False
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -298,6 +302,7 @@ class RingAttention(nn.Module):
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self.use_pallas else "xla",
+                self.ring_bidirectional and n_local % 2 == 0,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
@@ -441,6 +446,7 @@ class RingAttention(nn.Module):
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self.use_pallas else "xla",
+                self.ring_bidirectional and n_local % 2 == 0,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)

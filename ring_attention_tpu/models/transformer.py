@@ -58,6 +58,7 @@ class RingTransformer(nn.Module):
     mesh: Mesh | None = None
     use_pallas: bool = False
     sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
+    ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
     # rematerialize each block in backward: trades recompute for activation
     # memory — the standard recipe for quarter-million-token training.
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
@@ -90,6 +91,7 @@ class RingTransformer(nn.Module):
                 mesh=self.mesh,
                 use_pallas=self.use_pallas,
                 sequence_parallel=self.sequence_parallel,
+                ring_bidirectional=self.ring_bidirectional,
                 dtype=self.dtype,
             )
             for lookback in self._lookbacks()

@@ -74,8 +74,70 @@ def _ring_perm(axis_name: str, shift: int = 1) -> list[tuple[int, int]]:
     return [(j, (j + shift) % size) for j in range(size)]
 
 
-def _rotate(x, axis_name: str):
-    return lax.ppermute(x, axis_name, _ring_perm(axis_name))
+def _rotate(x, axis_name: str, shift: int = 1):
+    return lax.ppermute(x, axis_name, _ring_perm(axis_name, shift))
+
+
+def _streams(bidirectional: bool, n_local: int) -> list[tuple[int, int, int]]:
+    """KV circulation streams as ``(shift, key_offset, key_len)``.
+
+    Unidirectional: the whole local KV block rotates one way.  Bidirectional:
+    the block is split in half along the sequence; the halves circulate in
+    opposite directions, one ``ppermute`` each per hop.  Per-hop transfer
+    volume is unchanged but rides both directions of the (full-duplex) ICI
+    ring links, halving the exposed transfer time — the fallback/upgrade
+    discussed in ``docs/ring_overlap.md``.  Device r's hop ``i`` attends the
+    first half of origin ``r-i`` and the second half of origin ``r+i``; over
+    ``ring_size`` hops that covers every origin's both halves exactly once.
+    """
+    if not bidirectional:
+        return [(1, 0, n_local)]
+    assert n_local % 2 == 0, (
+        f"bidirectional ring needs an even local sequence, got {n_local}"
+    )
+    half = n_local // 2
+    return [(1, 0, half), (-1, half, half)]
+
+
+def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask):
+    """Streams + their sliced KV stacks and mask shards (fwd and bwd share
+    this so the fallback condition and slice bounds can never diverge).
+
+    Limited passes never see the reverse stream's useful origins in time
+    (see the ``bidirectional`` docstring) — run unidirectional instead.
+    """
+    streams = _streams(bidirectional and passes == ring_size, n_local)
+    kvs = tuple(
+        jnp.stack([k[:, :, ofs:ofs + nk], v[:, :, ofs:ofs + nk]])
+        for (_, ofs, nk) in streams
+    )
+    masks = (
+        tuple(kv_mask[:, ofs:ofs + nk] for (_, ofs, nk) in streams)
+        if kv_mask is not None
+        else ()
+    )
+    return streams, kvs, masks
+
+
+def _stream_offsets(stream, rank, i, n_local, causal, striped, window,
+                    ring_size):
+    """Band offsets ``(hi, lo)`` for one stream at hop ``i``.
+
+    A key at local index ``j`` within a half-block starting at ``key_offset``
+    sits at block-local index ``j + key_offset``; in both contiguous and
+    striped layouts that shifts the band bounds by exactly ``-key_offset``
+    (global key position is affine in the block-local index with unit
+    coefficient in the contiguous case and stride ``ring_size`` in the
+    striped case — the offset divides out identically)."""
+    shift, ofs, _ = stream
+    origin = (rank - shift * i) % ring_size
+    hi, lo = _hop_offsets(
+        rank, origin, n_local, causal, striped, window, ring_size
+    )
+    if ofs and hi is not None:
+        hi = hi - ofs
+        lo = lo - ofs if lo is not None else None
+    return hi, lo
 
 
 def _hop_offsets(
@@ -110,15 +172,15 @@ def _hop_offsets(
 
 
 def _hop_has_work(
-    hi: jax.Array | None, lo: jax.Array | None, n_local: int
+    hi: jax.Array | None, lo: jax.Array | None, n_q: int, n_k: int
 ) -> jax.Array:
     if hi is None:
         return jnp.bool_(True)
-    ok = hi >= -(n_local - 1)
+    ok = hi >= -(n_q - 1)
     if lo is not None:
         # lo > hi means an empty band: striped hops with window < ring_size
         # hold no in-window keys at all and can skip entirely
-        return ok & (lo <= n_local - 1) & (lo <= hi)
+        return ok & (lo <= n_k - 1) & (lo <= hi)
     return ok
 
 
@@ -200,6 +262,7 @@ def ring_flash_attention(
     softclamp_value: float | None = None,
     scale: float | None = None,
     impl: str = "xla",
+    bidirectional: bool = False,
 ) -> jax.Array:
     """Sequence-parallel exact attention; call inside ``shard_map``.
 
@@ -219,6 +282,16 @@ def ring_flash_attention(
       window: exact sliding-window lookback in tokens (exact in both
         contiguous and striped layouts).
       impl: per-hop compute path, ``"xla"`` or ``"pallas"``.
+      bidirectional: circulate the two halves of each KV block in opposite
+        ring directions (one ``ppermute`` each per hop).  Same totals, but
+        the transfer rides both directions of the full-duplex ICI links, so
+        the exposed per-hop communication time halves.  Requires an even
+        local sequence length.  Incompatible by construction with
+        ``max_ring_passes < ring_size``: the reverse stream delivers
+        *future* origins first, so a limited-pass window's trailing key
+        halves would only arrive near the end of a full circulation —
+        limited-pass calls silently run unidirectional instead (skipping
+        hops saves more than duplex transfer does).
 
     Cross-attention (unequal q/kv shard lengths) silently bypasses the ring
     and runs local flash over the local KV shard — the reference degrades
@@ -246,29 +319,29 @@ def ring_flash_attention(
         )
     return _ring_flash_attention_core(
         q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale, impl,
+        max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
     )
 
 
 @partial(
     jax.custom_vjp,
-    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12),
+    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13),
 )
 def _ring_flash_attention_core(
     q, k, v, kv_mask, axis_name, causal=False, striped=False,
     bucket_size=None, max_ring_passes=None, window=None,
-    softclamp_value=None, scale=None, impl="xla",
+    softclamp_value=None, scale=None, impl="xla", bidirectional=False,
 ):
     out, _ = _ring_fwd_impl(
         q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale, impl,
+        max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
     )
     return out
 
 
 def _ring_fwd_impl(
     q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-    max_ring_passes, window, softclamp_value, scale, impl,
+    max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
 ):
     if window is not None:
         assert causal, "lookback windows require causal attention"
@@ -284,61 +357,58 @@ def _ring_fwd_impl(
         impl, q, hk, scale, bucket_size, softclamp_value
     )
     carry = init()
-    kv = jnp.stack([k, v])  # one message per hop, ref ring_flash_attention.py:129
-    mask_carry = kv_mask
+    # one stacked (k, v) message per stream per hop, ref ring_flash_attention.py:129
+    streams, kvs, masks = _stream_state(
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask
+    )
 
-    def hop(i, flash, kv, mask_carry):
-        origin = (rank - i) % ring_size
-        hi, lo = _hop_offsets(
-            rank, origin, n_local, causal, striped, window, ring_size
-        )
-        has_work = _hop_has_work(hi, lo, n_local)
+    def hop(i, flash, kvs, masks):
+        new_kvs, new_masks = [], []
+        for si, stream in enumerate(streams):
+            kvx = kvs[si]
+            mx = masks[si] if masks else None
+            hi, lo = _stream_offsets(
+                stream, rank, i, n_local, causal, striped, window, ring_size
+            )
+            has_work = _hop_has_work(hi, lo, n_local, stream[2])
+            flash = lax.cond(
+                has_work,
+                lambda f, kvx=kvx, mx=mx, hi=hi, lo=lo: attend(
+                    f, kvx[0], kvx[1], mx, hi, lo
+                ),
+                lambda f: f,
+                flash,
+            )
+            # rotate AFTER compute; collective outside the cond so the
+            # schedule is uniform across devices
+            new_kvs.append(_rotate(kvx, axis_name, stream[0]))
+            if mx is not None:
+                new_masks.append(_rotate(mx, axis_name, stream[0]))
+        return flash, tuple(new_kvs), tuple(new_masks)
 
-        flash = lax.cond(
-            has_work,
-            lambda f: attend(f, kv[0], kv[1], mask_carry, hi, lo),
-            lambda f: f,
-            flash,
-        )
-        # rotate AFTER compute; collective outside the cond so the schedule
-        # is uniform across devices
-        kv = _rotate(kv, axis_name)
-        if mask_carry is not None:
-            mask_carry = _rotate(mask_carry, axis_name)
-        return flash, kv, mask_carry
+    def body(c, i):
+        flash, kvs, masks = c
+        return hop(i, flash, kvs, masks), None
 
-    if mask_carry is None:
-        def body(c, i):
-            flash, kv = c
-            flash, kv, _ = hop(i, flash, kv, None)
-            return (flash, kv), None
-
-        (carry, _), _ = lax.scan(body, (carry, kv), jnp.arange(passes))
-    else:
-        def body(c, i):
-            flash, kv, m = c
-            flash, kv, m = hop(i, flash, kv, m)
-            return (flash, kv, m), None
-
-        (carry, _, _), _ = lax.scan(body, (carry, kv, mask_carry), jnp.arange(passes))
+    (carry, _, _), _ = lax.scan(body, (carry, kvs, masks), jnp.arange(passes))
 
     return final(carry)
 
 
 def _ring_vjp_fwd(
     q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-    max_ring_passes, window, softclamp_value, scale, impl,
+    max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
 ):
     out, lse = _ring_fwd_impl(
         q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale, impl,
+        max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
     )
     return out, (q, k, v, kv_mask, out, lse)
 
 
 def _ring_vjp_bwd(
     axis_name, causal, striped, bucket_size, max_ring_passes, window,
-    softclamp_value, scale, impl, res, do,
+    softclamp_value, scale, impl, bidirectional, res, do,
 ):
     q, k, v, kv_mask, out, lse = res
     b, h, n_local, d = q.shape
@@ -358,58 +428,64 @@ def _ring_vjp_bwd(
             * _group_q(out, hk).astype(jnp.float32)
         ).sum(-1)
 
-    kv = jnp.stack([k, v])
-    dkv = match_vma(jnp.zeros((2, b, hk, n_local, d), jnp.float32), q)
+    streams, kvs, masks = _stream_state(
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask
+    )
+    dkvs = tuple(
+        match_vma(jnp.zeros((2, b, hk, nk, d), jnp.float32), q)
+        for (_, _, nk) in streams
+    )
     dq = match_vma(jnp.zeros((b, h, n_local, d), jnp.float32), q)
-    mask_carry = kv_mask
 
-    def hop(i, dq, kv, dkv, mask_carry):
-        origin = (rank - i) % ring_size
-        hi, lo = _hop_offsets(
-            rank, origin, n_local, causal, striped, window, ring_size
-        )
-        has_work = _hop_has_work(hi, lo, n_local)
-
-        def do_bwd(args):
-            dq, dkv = args
-            dq_i, dk_i, dv_i = _span_bwd(
-                impl, do, q, kv[0], kv[1], lse, delta, mask_carry, hi, lo,
-                scale, bucket_size, softclamp_value, hk,
+    def hop(i, dq, kvs, dkvs, masks):
+        new_kvs, new_dkvs, new_masks = [], [], []
+        for si, stream in enumerate(streams):
+            kvx, dkvx = kvs[si], dkvs[si]
+            mx = masks[si] if masks else None
+            hi, lo = _stream_offsets(
+                stream, rank, i, n_local, causal, striped, window, ring_size
             )
-            return dq + dq_i, dkv.at[0].add(dk_i).at[1].add(dv_i)
+            has_work = _hop_has_work(hi, lo, n_local, stream[2])
 
-        dq, dkv = lax.cond(has_work, do_bwd, lambda a: a, (dq, dkv))
-        kv = _rotate(kv, axis_name)
-        dkv = _rotate(dkv, axis_name)
-        if mask_carry is not None:
-            mask_carry = _rotate(mask_carry, axis_name)
-        return dq, kv, dkv, mask_carry
+            def do_bwd(args, kvx=kvx, mx=mx, hi=hi, lo=lo):
+                dq, dkvx = args
+                dq_i, dk_i, dv_i = _span_bwd(
+                    impl, do, q, kvx[0], kvx[1], lse, delta, mx, hi, lo,
+                    scale, bucket_size, softclamp_value, hk,
+                )
+                return dq + dq_i, dkvx.at[0].add(dk_i).at[1].add(dv_i)
 
-    if mask_carry is None:
-        def body(c, i):
-            dq, kv, dkv = c
-            dq, kv, dkv, _ = hop(i, dq, kv, dkv, None)
-            return (dq, kv, dkv), None
+            dq, dkvx = lax.cond(has_work, do_bwd, lambda a: a, (dq, dkvx))
+            new_kvs.append(_rotate(kvx, axis_name, stream[0]))
+            new_dkvs.append(_rotate(dkvx, axis_name, stream[0]))
+            if mx is not None:
+                new_masks.append(_rotate(mx, axis_name, stream[0]))
+        return dq, tuple(new_kvs), tuple(new_dkvs), tuple(new_masks)
 
-        (dq, kv, dkv), _ = lax.scan(body, (dq, kv, dkv), jnp.arange(passes))
-    else:
-        def body(c, i):
-            dq, kv, dkv, m = c
-            dq, kv, dkv, m = hop(i, dq, kv, dkv, m)
-            return (dq, kv, dkv, m), None
+    def body(c, i):
+        dq, kvs, dkvs, masks = c
+        return hop(i, dq, kvs, dkvs, masks), None
 
-        (dq, kv, dkv, _), _ = lax.scan(
-            body, (dq, kv, dkv, mask_carry), jnp.arange(passes)
-        )
+    (dq, kvs, dkvs, _), _ = lax.scan(
+        body, (dq, kvs, dkvs, masks), jnp.arange(passes)
+    )
 
-    # Catch-up rotation: after `passes` end-of-hop rotations the dkv shard on
-    # this device belongs to origin (rank - passes) % ring; one composed
-    # ppermute with shift (ring - passes) returns every shard to its owner
-    # in a single collective (the reference loops single hops instead,
+    # Catch-up rotation: after `passes` end-of-hop rotations by `shift` the
+    # dkv shard on this device belongs to origin (rank - shift*passes);
+    # one composed ppermute per stream returns every shard to its owner in
+    # a single collective (the reference loops single hops instead,
     # ref ring_flash_attention.py:380-385).
-    shift = (ring_size - passes) % ring_size
-    if shift:
-        dkv = lax.ppermute(dkv, axis_name, _ring_perm(axis_name, shift))
+    caught = []
+    for stream, dkvx in zip(streams, dkvs):
+        shift = (stream[0] * (ring_size - passes)) % ring_size
+        if shift:
+            dkvx = lax.ppermute(dkvx, axis_name, _ring_perm(axis_name, shift))
+        caught.append(dkvx)
+
+    if len(caught) == 1:
+        dkv = caught[0]
+    else:
+        dkv = jnp.concatenate(caught, axis=3)
 
     return (
         dq.astype(q.dtype),

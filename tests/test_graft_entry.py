@@ -39,9 +39,15 @@ def _run_bench_worker(args, timeout=300):
         f"import sys; sys.argv = {['bench.py', '--worker'] + args!r};"
         f"exec(open({bench_path!r}).read())"
     )
+    env = dict(
+        os.environ,
+        JAX_COMPILATION_CACHE_DIR=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        ),
+    )
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
-        timeout=timeout,
+        timeout=timeout, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-500:]
     return json.loads(proc.stdout.strip().splitlines()[-1])

@@ -70,6 +70,22 @@ def make_qkv(rng, b=2, h=4, hk=None, n=128, d=16):
     return q, k, v
 
 
+def banded_oracle(w):
+    """Dense causal sliding-window oracle: attend iff i-(w-1) <= j <= i."""
+
+    def oracle(q, k, v):
+        n = q.shape[2]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(n)[None, :]
+        band = (j <= i) & (j >= i - (w - 1))
+        s = jnp.einsum("bhid,bhjd->bhij", q, k) * (q.shape[-1] ** -0.5)
+        return jnp.einsum(
+            "bhij,bhjd->bhid", jax.nn.softmax(jnp.where(band, s, -1e30), -1), v
+        )
+
+    return oracle
+
+
 @pytest.fixture(scope="module")
 def mesh(  ):
     return create_mesh(ring_size=8)
@@ -131,18 +147,11 @@ def test_ring_data_axis(rng, mesh2x4):
 def test_ring_window(rng, mesh):
     """Sliding-window lookback with limited ring passes vs banded oracle."""
     q, k, v = make_qkv(rng)
-    n, w = 128, 32  # window of 32 tokens; shard=16 -> lookback spans 3 shards
+    w = 32  # window of 32 tokens; shard=16 -> lookback spans 3 shards
     out = ring_attn_global(
         q, k, v, mesh=mesh, causal=True, bucket_size=8, window=w, max_ring_passes=4
     )
-    i = jnp.arange(n)[:, None]
-    j = jnp.arange(n)[None, :]
-    band = (j <= i) & (j >= i - (w - 1))
-    s = jnp.einsum("bhid,bhjd->bhij", q, k) * (q.shape[-1] ** -0.5)
-    ref = jnp.einsum(
-        "bhij,bhjd->bhid", jax.nn.softmax(jnp.where(band, s, -1e30), -1), v
-    )
-    np.testing.assert_allclose(out, ref, atol=ATOL)
+    np.testing.assert_allclose(out, banded_oracle(w)(q, k, v), atol=ATOL)
 
 
 @pytest.mark.parametrize("striped", [False, True])
@@ -169,7 +178,7 @@ def test_ring_grads_limited_passes(rng, mesh):
     """dkv catch-up rotation: grads must land on the owner shard even when
     max_ring_passes < ring_size (ref ring_flash_attention.py:380-385)."""
     q, k, v = make_qkv(rng)
-    n, w = 128, 32
+    w = 32
 
     def loss_ring(q, k, v):
         return (
@@ -180,16 +189,8 @@ def test_ring_grads_limited_passes(rng, mesh):
             ** 2
         ).sum()
 
-    i = jnp.arange(n)[:, None]
-    j = jnp.arange(n)[None, :]
-    band = (j <= i) & (j >= i - (w - 1))
-
     def loss_ref(q, k, v):
-        s = jnp.einsum("bhid,bhjd->bhij", q, k) * (q.shape[-1] ** -0.5)
-        out = jnp.einsum(
-            "bhij,bhjd->bhid", jax.nn.softmax(jnp.where(band, s, -1e30), -1), v
-        )
-        return (out**2).sum()
+        return (banded_oracle(w)(q, k, v) ** 2).sum()
 
     g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
     g_out = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
@@ -258,17 +259,8 @@ def test_ring_striped_window_exact(rng, mesh, impl):
     approximates striped lookback at bucket granularity): per-hop band
     lower offsets reproduce the banded oracle, fwd and bwd."""
     q, k, v = make_qkv(rng)
-    n, w = 128, 40
-
-    i = jnp.arange(n)[:, None]
-    j = jnp.arange(n)[None, :]
-    band = (j <= i) & (j >= i - (w - 1))
-
-    def oracle(q, k, v):
-        s = jnp.einsum("bhid,bhjd->bhij", q, k) * (q.shape[-1] ** -0.5)
-        return jnp.einsum(
-            "bhij,bhjd->bhid", jax.nn.softmax(jnp.where(band, s, -1e30), -1), v
-        )
+    w = 40
+    oracle = banded_oracle(w)
 
     out = ring_attn_global(
         q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8, window=w,
@@ -326,17 +318,125 @@ def test_ring_cross_attention_degrades(rng, mesh, impl):
         np.testing.assert_allclose(a, b_, atol=GRAD_ATOL, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize(
+    "causal,striped", [(False, False), (True, False), (True, True)]
+)
+def test_ring_bidirectional_parity(rng, mesh, causal, striped):
+    """Bidirectional half-KV ring (opposite-direction ppermutes riding both
+    ICI directions): every origin's both halves are visited exactly once, so
+    outputs must match the oracle in all layouts."""
+    q, k, v = make_qkv(rng)
+    ref = default_attention(q, k, v, causal=causal)
+    out = ring_attn_global(
+        q, k, v, mesh=mesh, causal=causal, striped=striped, bucket_size=8,
+        bidirectional=True,
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+def test_ring_bidirectional_mask_gqa_grads(rng, mesh):
+    """Key-padding mask halves rotate with their KV halves; GQA dk/dv
+    group-sums land on the owner shard from both streams."""
+    q, k, v = make_qkv(rng, hk=2)
+    mask = jnp.asarray(rng.random((2, 128)) > 0.3)
+
+    def loss_ref(q, k, v):
+        return (default_attention(q, k, v, mask) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (
+            ring_attn_global(
+                q, k, v, mask, mesh=mesh, bucket_size=8, bidirectional=True
+            )
+            ** 2
+        ).sum()
+
+    np.testing.assert_allclose(
+        ring_attn_global(q, k, v, mask, mesh=mesh, bucket_size=8, bidirectional=True),
+        default_attention(q, k, v, mask),
+        atol=ATOL,
+    )
+    g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+def test_ring_bidirectional_window_limited_passes(rng, mesh):
+    """max_ring_passes < ring_size is incompatible with bidirectional
+    circulation (the reverse stream delivers future origins first, so a
+    window's trailing key halves would only arrive after a full ring) —
+    the implementation must silently fall back to unidirectional and still
+    match the banded oracle, fwd and bwd."""
+    q, k, v = make_qkv(rng)
+    w = 32
+    oracle = banded_oracle(w)
+
+    def ring(q, k, v):
+        return ring_attn_global(
+            q, k, v, mesh=mesh, causal=True, bucket_size=8, window=w,
+            max_ring_passes=4, bidirectional=True,
+        )
+
+    np.testing.assert_allclose(ring(q, k, v), oracle(q, k, v), atol=ATOL)
+    g_ref = jax.grad(lambda *a: (oracle(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda *a: (ring(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+def test_ring_bidirectional_striped_window(rng, mesh):
+    """Striped + sliding window + bidirectional at FULL passes: the reverse
+    stream's band lower-bound shift (lo - key_offset under the stripe
+    interleave) is the trickiest line of the band math — pin it to the
+    banded oracle, fwd and bwd."""
+    q, k, v = make_qkv(rng)
+    w = 32
+    oracle = banded_oracle(w)
+
+    def ring(q, k, v):
+        return ring_attn_global(
+            q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8,
+            window=w, bidirectional=True,
+        )
+
+    np.testing.assert_allclose(ring(q, k, v), oracle(q, k, v), atol=ATOL)
+    g_ref = jax.grad(lambda *a: (oracle(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda *a: (ring(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+def test_ring_bidirectional_pallas(rng, mesh):
+    """Bidirectional streams through the Pallas per-hop kernels."""
+    q, k, v = make_qkv(rng, hk=2)
+    ref = default_attention(q, k, v, causal=True)
+    out = ring_attn_global(
+        q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8,
+        impl="pallas", bidirectional=True,
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
 def test_ring_determinism(rng, mesh):
     """Bitwise repeatability across FRESH compilations (caches cleared
     between runs): the compiled collective schedule fixes the reduction
     order, replacing the reference's reliance on per-hop barriers for
     reproducibility."""
     q, k, v = make_qkv(rng)
-    a = np.asarray(
-        ring_attn_global(q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8)
-    )
-    jax.clear_caches()  # force a recompile; same-executable equality is trivial
-    b = np.asarray(
-        ring_attn_global(q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8)
-    )
+    # the persistent on-disk cache (conftest) would hand the second compile
+    # the identical serialized executable, making the comparison trivial —
+    # bypass it for this test so both compiles are genuinely fresh
+    cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        a = np.asarray(
+            ring_attn_global(q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8)
+        )
+        jax.clear_caches()  # force a recompile; same-executable equality is trivial
+        b = np.asarray(
+            ring_attn_global(q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8)
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
     np.testing.assert_array_equal(a, b)

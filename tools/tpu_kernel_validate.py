@@ -27,6 +27,8 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=262144)
     ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA: fewer KV heads (BASELINE config 4 is 32/4)")
     ap.add_argument("--dim-head", type=int, default=64)
     args = ap.parse_args()
 
@@ -44,12 +46,14 @@ def main() -> None:
     dev = jax.devices()[0]
     print(json.dumps({"device": getattr(dev, "device_kind", str(dev))}))
     h, d = args.heads, args.dim_head
+    hk = args.kv_heads or h
     scale = d**-0.5
 
     # ---- parity at a small shape: compact grid vs rectangular vs oracle
     n0 = 2048
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (1, h, n0, d), jnp.bfloat16) for kk in ks)
+    q = jax.random.normal(ks[0], (1, h, n0, d), jnp.bfloat16)
+    k, v = (jax.random.normal(kk, (1, hk, n0, d), jnp.bfloat16) for kk in ks[1:])
     compact = finalize_partials(
         pallas_flash_partials(q, k, v, scale=scale, causal_offset=0,
                               interpret=False)
@@ -74,7 +78,8 @@ def main() -> None:
     # ---- timing at the target shape
     seq = args.seq
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
-    q, k, v = (jax.random.normal(kk, (1, h, seq, d), jnp.bfloat16) for kk in ks)
+    q = jax.random.normal(ks[0], (1, h, seq, d), jnp.bfloat16)
+    k, v = (jax.random.normal(kk, (1, hk, seq, d), jnp.bfloat16) for kk in ks[1:])
     flops_fwd = 2 * 2 * seq * seq * h * d * 0.5
 
     def fwd_chained(bq, bk, iters):
