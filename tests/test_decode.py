@@ -18,15 +18,24 @@ ATOL = 3e-5
 VOCAB = 128
 
 
-def _decode_all(model, params, tokens, max_len):
-    """Run decode_step over each token; stack per-step logits."""
-    b, n = tokens.shape
-    cache = model.apply(params, b, max_len, method=RingTransformer.init_cache)
+def _jit_decode_fns(model):
+    """Jitted (prefill, decode_step) closures for ``model``."""
+    prefill = jax.jit(
+        lambda p, t, c: model.apply(p, t, c, method=RingTransformer.prefill)
+    )
     step = jax.jit(
         lambda p, tok, c, i: model.apply(
             p, tok, c, i, method=RingTransformer.decode_step
         )
     )
+    return prefill, step
+
+
+def _decode_all(model, params, tokens, max_len):
+    """Run decode_step over each token; stack per-step logits."""
+    b, n = tokens.shape
+    cache = model.apply(params, b, max_len, method=RingTransformer.init_cache)
+    _, step = _jit_decode_fns(model)
     outs = []
     for i in range(n):
         logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
@@ -79,11 +88,13 @@ def test_generate_greedy(rng):
     )
     assert gen.shape == (2, 4)
 
-    # oracle: repeatedly run the full forward and take argmax
+    # oracle: repeatedly run the full forward and take argmax (jitted so
+    # the per-shape executables land in the persistent cache)
+    fwd = jax.jit(lambda p, s: model.apply(p, s))
     seq = prompt
     expect = []
     for _ in range(4):
-        logits = model.apply(params, seq)
+        logits = fwd(params, seq)
         tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         expect.append(tok)
         seq = jnp.concatenate([seq, tok[:, None]], axis=1)
@@ -115,16 +126,12 @@ def test_prefill_then_decode(rng):
     full = model.apply(params, tokens)
 
     cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
-    logits, cache = model.apply(
-        params, tokens[:, :8], cache, method=RingTransformer.prefill
-    )
+    prefill, step = _jit_decode_fns(model)
+    logits, cache = prefill(params, tokens[:, :8], cache)
     np.testing.assert_allclose(logits, full[:, 7], atol=ATOL)
     # continue decoding from position 8
     for i in (8, 9):
-        logits, cache = model.apply(
-            params, tokens[:, i], cache, jnp.int32(i),
-            method=RingTransformer.decode_step,
-        )
+        logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
         np.testing.assert_allclose(logits, full[:, i], atol=ATOL)
 
 
@@ -160,13 +167,9 @@ def test_ring_prefill_then_decode(rng):
     full = ref_model.apply(params, tokens)
 
     cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
-    logits, cache = model.apply(
-        params, tokens[:, :9], cache, method=RingTransformer.prefill
-    )
+    prefill, step = _jit_decode_fns(model)
+    logits, cache = prefill(params, tokens[:, :9], cache)
     np.testing.assert_allclose(logits, full[:, 8], atol=ATOL)
     for i in (9, 10):
-        logits, cache = model.apply(
-            params, tokens[:, i], cache, jnp.int32(i),
-            method=RingTransformer.decode_step,
-        )
+        logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
         np.testing.assert_allclose(logits, full[:, i], atol=ATOL)
