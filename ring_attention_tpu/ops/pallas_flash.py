@@ -107,6 +107,71 @@ def _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed):
     return ok
 
 
+def _tile_is_edge(offs_ref, row0, col0, bq, bk, causal, windowed):
+    """True when the tile straddles the band boundary (some element is
+    out-of-band) — only those tiles need the iota/compare/select mask.
+    Mirrors the static ``interior`` classification in ``_band_tables`` so
+    the compact and rectangular grids run identical per-tile expressions
+    (keeping them bit-exact against each other)."""
+    if not causal:
+        return jnp.bool_(False)
+    interior = col0 + bk - 1 <= row0 + offs_ref[0]
+    if windowed:
+        interior = jnp.logical_and(
+            interior, col0 >= row0 + bq - 1 + offs_ref[1]
+        )
+    return jnp.logical_not(interior)
+
+
+def _dispatch_tile(offs_ref, row0, col0, bq, bk, causal, windowed, tile):
+    """Run ``tile()`` under the band's block-skip / edge-vs-interior
+    predicates.  Interior tiles (fully in-band) take a variant with the
+    causal/window mask construction statically compiled out — the per-tile
+    iota/compare/select is ~half the non-matmul work, and at long sequence
+    nearly every tile is interior.  A kv-padding mask still applies there.
+    """
+    if not causal:
+        tile()
+        return
+    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
+    edge = _tile_is_edge(offs_ref, row0, col0, bq, bk, causal, windowed)
+
+    @pl.when(has_work & edge)
+    def _compute_edge():
+        tile()
+
+    @pl.when(has_work & jnp.logical_not(edge))
+    def _compute_interior():
+        tile(causal=False, windowed=False)
+
+
+def _dispatch_tile_compact(tf, tile):
+    """Compact-grid analogue of :func:`_dispatch_tile`: the edge/interior
+    classification was resolved at table-build time into the ``EDGE`` flag
+    (compact grids exist only for static causal bands)."""
+    work = (tf & _TF_WORK) != 0
+    edge = (tf & _TF_EDGE) != 0
+
+    @pl.when(work & edge)
+    def _compute_edge():
+        tile()
+
+    @pl.when(work & jnp.logical_not(edge))
+    def _compute_interior():
+        tile(causal=False, windowed=False)
+
+
+def _tile_closure(fn, kw, *args):
+    """``tile(**over)`` closure for the dispatchers: runs ``fn(*args)`` with
+    the kernel's shared tile kwargs, per-call-overridable (the interior fast
+    path overrides ``causal``/``windowed``)."""
+
+    def tile(**over):
+        fn(*args, **{**kw, **over})
+
+    return tile
+
+
 def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
     """Per-element keep mask for a score tile, or None if unmasked.
 
@@ -146,7 +211,7 @@ def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
 # but resolved at trace time into a smaller grid rather than at runtime.
 # ---------------------------------------------------------------------------
 
-_TF_FIRST, _TF_LAST, _TF_WORK = 1, 2, 4
+_TF_FIRST, _TF_LAST, _TF_WORK, _TF_EDGE = 1, 2, 4, 8
 
 
 def _compact_maps(h: int, hk: int, g: int):
@@ -187,6 +252,11 @@ def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hi, lo, windowed,
     dummy entry (flags = FIRST|LAST, no WORK) so their zero-initialized
     output block is still written, matching the rectangular grid's
     behavior for fully-masked rows.
+
+    ``EDGE`` marks tiles that straddle the band boundary; interior tiles
+    (every element satisfies ``lo <= j - i <= hi``) clear it, and the
+    kernels skip the iota/compare/select mask construction for them —
+    under a long-sequence causal grid that is ~99% of the active tiles.
     """
     tq, tk, tf = [], [], []
     outer_n = n_q_blocks if outer_is_q else n_k_blocks
@@ -200,9 +270,12 @@ def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hi, lo, windowed,
             if windowed:
                 active = active and col0 + bk - 1 >= row0 + lo
             if active:
+                interior = col0 + bk - 1 <= row0 + hi and (
+                    not windowed or col0 >= row0 + bq - 1 + lo
+                )
                 tq.append(qi)
                 tk.append(ki)
-                tf.append(_TF_WORK)
+                tf.append(_TF_WORK | (0 if interior else _TF_EDGE))
         if len(tf) == start:  # empty row: dummy entry, write zeros
             tq.append(o if outer_is_q else 0)
             tk.append(0 if outer_is_q else o)
@@ -235,15 +308,10 @@ def _fwd_kernel(
     m,  # (bq, 1) f32
     l,  # (bq, 1) f32
     *,
-    scale: float,
-    softclamp_value: float | None,
-    causal: bool,
-    windowed: bool,
-    masked: bool,
-    bq: int,
-    bk: int,
     nk_blocks: int,
+    **tile_kw,  # scale/softclamp_value/causal/windowed/masked/bq/bk
 ):
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -255,14 +323,11 @@ def _fwd_kernel(
     qi = pl.program_id(1)
     row0 = qi * bq
     col0 = ki * bk
-    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
 
-    @pl.when(has_work)
-    def _compute():
-        _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l,
-                  row0, col0, scale=scale, softclamp_value=softclamp_value,
-                  causal=causal, windowed=windowed, masked=masked,
-                  bq=bq, bk=bk)
+    tile = _tile_closure(_fwd_tile, tile_kw, offs_ref, q_ref, k_ref, v_ref,
+                         kvm_ref, acc, m, l, row0, col0)
+    _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
+                   tile_kw["windowed"], tile)
 
     @pl.when(ki == nk_blocks - 1)
     def _write():
@@ -307,9 +372,9 @@ def _fwd_kernel_compact(
     q_ref, k_ref, v_ref, kvm_ref,
     acc_ref, m_ref, l_ref,
     acc, m, l,
-    *,
-    scale, softclamp_value, causal, windowed, masked, bq, bk,
+    **tile_kw,
 ):
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
     t = pl.program_id(1)
     tf = tf_ref[t]
 
@@ -319,12 +384,9 @@ def _fwd_kernel_compact(
         m[:] = jnp.full_like(m, MASK_VALUE)
         l[:] = jnp.zeros_like(l)
 
-    @pl.when((tf & _TF_WORK) != 0)
-    def _compute():
-        _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l,
-                  tq_ref[t] * bq, tk_ref[t] * bk, scale=scale,
-                  softclamp_value=softclamp_value, causal=causal,
-                  windowed=windowed, masked=masked, bq=bq, bk=bk)
+    tile = _tile_closure(_fwd_tile, tile_kw, offs_ref, q_ref, k_ref, v_ref,
+                         kvm_ref, acc, m, l, tq_ref[t] * bq, tk_ref[t] * bk)
+    _dispatch_tile_compact(tf, tile)
 
     @pl.when((tf & _TF_LAST) != 0)
     def _write():
@@ -552,15 +614,10 @@ def _bwd_dkv_kernel(
     dk,  # scratch (bk, d) f32
     dv,  # scratch (bk, d) f32
     *,
-    scale: float,
-    softclamp_value: float | None,
-    causal: bool,
-    windowed: bool,
-    masked: bool,
-    bq: int,
-    bk: int,
     nq_blocks: int,
+    **tile_kw,
 ):
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
     qi = pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -571,14 +628,11 @@ def _bwd_dkv_kernel(
     ki = pl.program_id(1)
     row0 = qi * bq
     col0 = ki * bk
-    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
 
-    @pl.when(has_work)
-    def _compute():
-        _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                  kvm_ref, dk, dv, row0, col0, scale=scale,
-                  softclamp_value=softclamp_value, causal=causal,
-                  windowed=windowed, masked=masked, bq=bq, bk=bk)
+    tile = _tile_closure(_dkv_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
+                         delta_ref, k_ref, v_ref, kvm_ref, dk, dv, row0, col0)
+    _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
+                   tile_kw["windowed"], tile)
 
     @pl.when(qi == nq_blocks - 1)
     def _write():
@@ -630,9 +684,9 @@ def _bwd_dkv_kernel_compact(
     offs_ref, tq_ref, tk_ref, tf_ref,
     q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, kvm_ref,
     dk_ref, dv_ref, dk, dv,
-    *,
-    scale, softclamp_value, causal, windowed, masked, bq, bk,
+    **tile_kw,
 ):
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
     t = pl.program_id(1)
     tf = tf_ref[t]
 
@@ -641,12 +695,10 @@ def _bwd_dkv_kernel_compact(
         dk[:] = jnp.zeros_like(dk)
         dv[:] = jnp.zeros_like(dv)
 
-    @pl.when((tf & _TF_WORK) != 0)
-    def _compute():
-        _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                  kvm_ref, dk, dv, tq_ref[t] * bq, tk_ref[t] * bk,
-                  scale=scale, softclamp_value=softclamp_value, causal=causal,
-                  windowed=windowed, masked=masked, bq=bq, bk=bk)
+    tile = _tile_closure(_dkv_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
+                         delta_ref, k_ref, v_ref, kvm_ref, dk, dv,
+                         tq_ref[t] * bq, tk_ref[t] * bk)
+    _dispatch_tile_compact(tf, tile)
 
     @pl.when((tf & _TF_LAST) != 0)
     def _write():
@@ -680,15 +732,10 @@ def _bwd_dq_kernel(
     dq_ref,  # (1, bq, d) f32
     dq,  # scratch (bq, d) f32
     *,
-    scale: float,
-    softclamp_value: float | None,
-    causal: bool,
-    windowed: bool,
-    masked: bool,
-    bq: int,
-    bk: int,
     nk_blocks: int,
+    **tile_kw,
 ):
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -698,14 +745,11 @@ def _bwd_dq_kernel(
     qi = pl.program_id(1)
     row0 = qi * bq
     col0 = ki * bk
-    has_work = _tile_has_work(offs_ref, row0, col0, bq, bk, causal, windowed)
 
-    @pl.when(has_work)
-    def _compute():
-        _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                 kvm_ref, dq, row0, col0, scale=scale,
-                 softclamp_value=softclamp_value, causal=causal,
-                 windowed=windowed, masked=masked, bq=bq, bk=bk)
+    tile = _tile_closure(_dq_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
+                         delta_ref, k_ref, v_ref, kvm_ref, dq, row0, col0)
+    _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
+                   tile_kw["windowed"], tile)
 
     @pl.when(ki == nk_blocks - 1)
     def _write():
@@ -750,9 +794,9 @@ def _bwd_dq_kernel_compact(
     offs_ref, tq_ref, tk_ref, tf_ref,
     q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, kvm_ref,
     dq_ref, dq,
-    *,
-    scale, softclamp_value, causal, windowed, masked, bq, bk,
+    **tile_kw,
 ):
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
     t = pl.program_id(1)
     tf = tf_ref[t]
 
@@ -760,12 +804,10 @@ def _bwd_dq_kernel_compact(
     def _init():
         dq[:] = jnp.zeros_like(dq)
 
-    @pl.when((tf & _TF_WORK) != 0)
-    def _compute():
-        _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                 kvm_ref, dq, tq_ref[t] * bq, tk_ref[t] * bk, scale=scale,
-                 softclamp_value=softclamp_value, causal=causal,
-                 windowed=windowed, masked=masked, bq=bq, bk=bk)
+    tile = _tile_closure(_dq_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
+                         delta_ref, k_ref, v_ref, kvm_ref, dq,
+                         tq_ref[t] * bq, tk_ref[t] * bk)
+    _dispatch_tile_compact(tf, tile)
 
     @pl.when((tf & _TF_LAST) != 0)
     def _write():
