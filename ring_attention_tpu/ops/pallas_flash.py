@@ -213,6 +213,13 @@ def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
 
 _TF_FIRST, _TF_LAST, _TF_WORK, _TF_EDGE = 1, 2, 4, 8
 
+# The compact grid's (t_q, t_k, flags) tables are scalar-prefetched into
+# SMEM; small blocks at long sequence can blow past it (512x512 at seq
+# 262144 is ~131k tiles x 3 tables x 4B ~ 1.6 MB — Mosaic rejects the
+# compile).  Beyond this cap the rectangular grid (runtime predicates, no
+# tables) is used instead.
+_MAX_COMPACT_TILES = 65536
+
 
 def _compact_maps(h: int, hk: int, g: int):
     """Index maps for a compacted grid (bh, t): q-side blocks follow the
@@ -460,13 +467,14 @@ def pallas_flash_partials(
     )
 
     if compact:
-        tq_a, tk_a, tf_a = (
-            jnp.asarray(t)
-            for t in _band_tables(nq // bq, nk // bk, bq, bk,
-                                  int(causal_offset),
-                                  int(window_lo) if windowed else 0,
-                                  windowed, outer_is_q=True)
-        )
+        tabs = _band_tables(nq // bq, nk // bk, bq, bk,
+                            int(causal_offset),
+                            int(window_lo) if windowed else 0,
+                            windowed, outer_is_q=True)
+        compact = tabs[0].shape[0] <= _MAX_COMPACT_TILES
+
+    if compact:
+        tq_a, tk_a, tf_a = (jnp.asarray(t) for t in tabs)
         q, k, v, kv_mask, offs, tq_a, tk_a, tf_a = _unify_vma(
             q, k, v, kv_mask, offs, tq_a, tk_a, tf_a
         )
@@ -882,16 +890,16 @@ def pallas_flash_backward(
     if compact:
         hi = int(causal_offset)
         lo = int(window_lo) if windowed else 0
-        dkv_tabs = [
-            jnp.asarray(t)
-            for t in _band_tables(nq // bq1, nk // bk1, bq1, bk1, hi, lo,
-                                  windowed, outer_is_q=False)
-        ]
-        dq_tabs = [
-            jnp.asarray(t)
-            for t in _band_tables(nq // bq2, nk // bk2, bq2, bk2, hi, lo,
-                                  windowed, outer_is_q=True)
-        ]
+        dkv_raw = _band_tables(nq // bq1, nk // bk1, bq1, bk1, hi, lo,
+                               windowed, outer_is_q=False)
+        compact = dkv_raw[0].shape[0] <= _MAX_COMPACT_TILES
+    if compact:
+        dq_raw = _band_tables(nq // bq2, nk // bk2, bq2, bk2, hi, lo,
+                              windowed, outer_is_q=True)
+        compact = dq_raw[0].shape[0] <= _MAX_COMPACT_TILES
+    if compact:
+        dkv_tabs = [jnp.asarray(t) for t in dkv_raw]
+        dq_tabs = [jnp.asarray(t) for t in dq_raw]
         unified = _unify_vma(
             q, k, v, do, lse, delta, kv_mask, offs, *dkv_tabs, *dq_tabs
         )

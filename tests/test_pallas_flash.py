@@ -222,6 +222,37 @@ def test_compact_grid_backward_matches_rectangular(rng, co, wlo, masked):
         np.testing.assert_array_equal(a, b, err_msg=name)
 
 
+def test_compact_table_cap_demotes_to_rectangular(rng, monkeypatch):
+    """A static band whose tile tables exceed _MAX_COMPACT_TILES (SMEM
+    scalar-prefetch budget) must silently take the rectangular grid and
+    produce identical results, fwd and bwd."""
+    import ring_attention_tpu.ops.pallas_flash as pf
+
+    q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    do = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    scale = q.shape[-1] ** -0.5
+
+    def run_all():
+        parts = pf.pallas_flash_partials(
+            q, k, v, scale=scale, causal_offset=0,
+            block_q=64, block_k=64, interpret=True,
+        )
+        out, lse = finalize_partials(parts)
+        delta = (do * out).sum(-1)
+        grads = pf.pallas_flash_backward(
+            do, q, k, v, lse, delta, scale=scale, causal_offset=0,
+            block_q=64, block_k=64, interpret=True,
+        )
+        return (parts.acc, parts.m, parts.l, *grads)
+
+    compact = run_all()
+    monkeypatch.setattr(pf, "_MAX_COMPACT_TILES", 2)  # force demotion
+    demoted = run_all()
+    for a, b, name in zip(compact, demoted,
+                          ("acc", "m", "l", "dq", "dk", "dv")):
+        np.testing.assert_array_equal(a, b, err_msg=name)
+
+
 @pytest.mark.parametrize(
     "traced,masked", [(False, False), (True, False), (True, True)],
     ids=["compact", "rectangular", "rectangular-masked"],
