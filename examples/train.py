@@ -31,6 +31,8 @@ def main() -> None:
                     help="rematerialize blocks (activation memory savings)")
     ap.add_argument("--use-pallas", action="store_true",
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
+    ap.add_argument("--bidirectional", action="store_true",
+                    help="circulate KV halves both ring directions (duplex ICI)")
     args = ap.parse_args()
 
     if args.fake_devices:
@@ -66,6 +68,7 @@ def main() -> None:
         mesh=mesh,
         use_ring=mesh is not None,
         use_pallas=args.use_pallas,
+        ring_bidirectional=args.bidirectional,
         remat=args.remat,
         dtype=jnp.bfloat16 if args.bf16 else None,
     )

@@ -20,6 +20,7 @@ ref ``tree_attn_decoding.py:23-103``).
 from __future__ import annotations
 
 import math
+import warnings
 
 import flax.linen as nn
 import jax
@@ -71,8 +72,8 @@ class RingAttention(nn.Module):
     #   "ulysses" — all-to-all head parallelism (not in the reference)
     sequence_parallel: str = "ring"
     # circulate KV halves in opposite ring directions (full-duplex ICI);
-    # applies when the local shard length is even, silently unidirectional
-    # otherwise (odd shards only arise from padding edge cases)
+    # applies when the local shard length is even, unidirectional with a
+    # warning otherwise (odd shards only arise from padding edge cases)
     ring_bidirectional: bool = False
     dtype: jnp.dtype | None = None
 
@@ -93,6 +94,18 @@ class RingAttention(nn.Module):
         if self.mesh is None:
             return 1
         return self.mesh.shape[SEQ_AXIS]
+
+    def _bidirectional(self, n_local: int) -> bool:
+        """Bidirectional streams need an even local shard; warn on the
+        silent unidirectional fallback so benchmarks aren't misread."""
+        if self.ring_bidirectional and n_local % 2:
+            warnings.warn(
+                f"ring_bidirectional requested but the per-device sequence "
+                f"length ({n_local}) is odd; running the unidirectional ring",
+                stacklevel=3,
+            )
+            return False
+        return self.ring_bidirectional
 
     def _project_qkv(self, x: jax.Array):
         """prenorm + fused qkv -> heads-major (b, h|hk, n, dh)."""
@@ -264,6 +277,7 @@ class RingAttention(nn.Module):
         while n_local % bucket:
             bucket -= 1
 
+        bidirectional = self._bidirectional(n_local)
         max_ring_passes = None
         window = None
         lookback = self.max_lookback_seq_len
@@ -302,7 +316,7 @@ class RingAttention(nn.Module):
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self.use_pallas else "xla",
-                self.ring_bidirectional and n_local % 2 == 0,
+                bidirectional,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
@@ -433,6 +447,7 @@ class RingAttention(nn.Module):
         while n_local % bucket:
             bucket -= 1
 
+        bidirectional = self._bidirectional(n_local)
         max_ring_passes = None
         window = None
         if self.max_lookback_seq_len is not None:
@@ -446,7 +461,7 @@ class RingAttention(nn.Module):
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self.use_pallas else "xla",
-                self.ring_bidirectional and n_local % 2 == 0,
+                bidirectional,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)

@@ -249,6 +249,32 @@ def _static_band(causal, windowed, causal_offset, window_lo):
     return not windowed or isinstance(window_lo, (int, np.integer))
 
 
+def _band_tile_count(n_q_blocks, n_k_blocks, bq, bk, hi, lo, windowed,
+                     outer_is_q: bool) -> int:
+    """Length of the :func:`_band_tables` tables, in closed form per outer
+    row (no table construction — the SMEM cap check must not pay for
+    building tables it is about to reject).  Pinned against the real
+    tables in ``tests/test_pallas_flash.py``."""
+    outer_n = n_q_blocks if outer_is_q else n_k_blocks
+    inner_n = n_k_blocks if outer_is_q else n_q_blocks
+    count = 0
+    for o in range(outer_n):
+        if outer_is_q:
+            row0 = o * bq
+            # active ki: ki*bk <= row0+bq-1+hi; windowed: ki*bk+bk-1 >= row0+lo
+            i_hi = min((row0 + bq - 1 + hi) // bk, inner_n - 1)
+            i_lo = max(-((-(row0 + lo - bk + 1)) // bk), 0) if windowed else 0
+        else:
+            col0 = o * bk
+            # active qi: col0 <= qi*bq+bq-1+hi; windowed: col0+bk-1 >= qi*bq+lo
+            i_lo = max(-((-(col0 - hi - bq + 1)) // bq), 0)
+            i_hi = (min((col0 + bk - 1 - lo) // bq, inner_n - 1)
+                    if windowed else inner_n - 1)
+        n = i_hi - i_lo + 1
+        count += n if n > 0 else 1  # empty rows get a dummy entry
+    return count
+
+
 def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hi, lo, windowed,
                  outer_is_q: bool):
     """(t_q, t_k, flags) int32 tables enumerating active band tiles.
@@ -467,14 +493,18 @@ def pallas_flash_partials(
     )
 
     if compact:
-        tabs = _band_tables(nq // bq, nk // bk, bq, bk,
-                            int(causal_offset),
-                            int(window_lo) if windowed else 0,
-                            windowed, outer_is_q=True)
-        compact = tabs[0].shape[0] <= _MAX_COMPACT_TILES
+        hi = int(causal_offset)
+        lo = int(window_lo) if windowed else 0
+        compact = _band_tile_count(
+            nq // bq, nk // bk, bq, bk, hi, lo, windowed, outer_is_q=True
+        ) <= _MAX_COMPACT_TILES
 
     if compact:
-        tq_a, tk_a, tf_a = (jnp.asarray(t) for t in tabs)
+        tq_a, tk_a, tf_a = (
+            jnp.asarray(t)
+            for t in _band_tables(nq // bq, nk // bk, bq, bk, hi, lo,
+                                  windowed, outer_is_q=True)
+        )
         q, k, v, kv_mask, offs, tq_a, tk_a, tf_a = _unify_vma(
             q, k, v, kv_mask, offs, tq_a, tk_a, tf_a
         )
@@ -886,29 +916,38 @@ def pallas_flash_backward(
         [causal_offset if causal else 0, window_lo if windowed else 0], jnp.int32
     )
 
-    compact = _static_band(causal, windowed, causal_offset, window_lo)
-    if compact:
+    static = _static_band(causal, windowed, causal_offset, window_lo)
+    # each pass has its own grid/tables: the SMEM cap demotes them
+    # independently (per-pass block sizes can put one over, not the other)
+    compact_dkv = compact_dq = False
+    dkv_tabs = dq_tabs = []
+    if static:
         hi = int(causal_offset)
         lo = int(window_lo) if windowed else 0
-        dkv_raw = _band_tables(nq // bq1, nk // bk1, bq1, bk1, hi, lo,
-                               windowed, outer_is_q=False)
-        compact = dkv_raw[0].shape[0] <= _MAX_COMPACT_TILES
-    if compact:
-        dq_raw = _band_tables(nq // bq2, nk // bk2, bq2, bk2, hi, lo,
-                              windowed, outer_is_q=True)
-        compact = dq_raw[0].shape[0] <= _MAX_COMPACT_TILES
-    if compact:
-        dkv_tabs = [jnp.asarray(t) for t in dkv_raw]
-        dq_tabs = [jnp.asarray(t) for t in dq_raw]
-        unified = _unify_vma(
-            q, k, v, do, lse, delta, kv_mask, offs, *dkv_tabs, *dq_tabs
-        )
-        q, k, v, do, lse, delta, kv_mask, offs = unified[:8]
-        dkv_tabs, dq_tabs = unified[8:11], unified[11:14]
-    else:
-        q, k, v, do, lse, delta, kv_mask, offs = _unify_vma(
-            q, k, v, do, lse, delta, kv_mask, offs
-        )
+        compact_dkv = _band_tile_count(
+            nq // bq1, nk // bk1, bq1, bk1, hi, lo, windowed, outer_is_q=False
+        ) <= _MAX_COMPACT_TILES
+        compact_dq = _band_tile_count(
+            nq // bq2, nk // bk2, bq2, bk2, hi, lo, windowed, outer_is_q=True
+        ) <= _MAX_COMPACT_TILES
+        if compact_dkv:
+            dkv_tabs = [
+                jnp.asarray(t)
+                for t in _band_tables(nq // bq1, nk // bk1, bq1, bk1, hi, lo,
+                                      windowed, outer_is_q=False)
+            ]
+        if compact_dq:
+            dq_tabs = [
+                jnp.asarray(t)
+                for t in _band_tables(nq // bq2, nk // bk2, bq2, bk2, hi, lo,
+                                      windowed, outer_is_q=True)
+            ]
+    unified = _unify_vma(
+        q, k, v, do, lse, delta, kv_mask, offs, *dkv_tabs, *dq_tabs
+    )
+    q, k, v, do, lse, delta, kv_mask, offs = unified[:8]
+    dkv_tabs = unified[8:8 + len(dkv_tabs)]
+    dq_tabs = unified[8 + len(dkv_tabs):]
     qr = q.reshape(b * h, nq, d)
     dor = do.reshape(b * h, nq, d).astype(q.dtype)
     lser = lse.reshape(b * h, nq, 1)
@@ -947,7 +986,7 @@ def pallas_flash_backward(
     common2 = dict(common1, bq=bq2, bk=bk2)
 
     # ---- dk/dv pass: grid (bh, k blocks, q blocks), or compacted band ----
-    if compact:
+    if compact_dkv:
         dkv_q_map, dkv_kv_map, dkv_kvm_map, dkv_out_map = _compact_maps(h, hk, g)
         dkv_scalars = (offs, *dkv_tabs)
         dkv_grid = (b * h, dkv_tabs[0].shape[0])
@@ -1016,7 +1055,7 @@ def pallas_flash_backward(
     dv = dv_h.reshape(b, hk, g, nk, d).sum(axis=2)
 
     # ---- dq pass: grid (bh, q blocks, k blocks), or compacted band ----
-    if compact:
+    if compact_dq:
         dq_q_map, dq_kv_map, dq_kvm_map, _ = _compact_maps(h, hk, g)
         dq_scalars = (offs, *dq_tabs)
         dq_grid = (b * h, dq_tabs[0].shape[0])

@@ -40,6 +40,11 @@ or beyond the lookback window) skip their compute through ``lax.cond`` —
 the per-device branch resolves at run time from ``axis_index``, while the
 ppermute stays outside the cond so the collective schedule is identical on
 every device.
+
+KV circulates as one or more *streams* (``_streams``): unidirectional is
+one whole-block stream; ``bidirectional=True`` splits the block into two
+halves ppermuted in opposite directions so per-hop transfers ride both
+directions of the full-duplex ICI links (``docs/ring_overlap.md``).
 """
 
 from __future__ import annotations

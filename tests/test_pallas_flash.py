@@ -222,6 +222,24 @@ def test_compact_grid_backward_matches_rectangular(rng, co, wlo, masked):
         np.testing.assert_array_equal(a, b, err_msg=name)
 
 
+@pytest.mark.parametrize("outer_is_q", [True, False])
+@pytest.mark.parametrize(
+    "hi,lo,windowed",
+    [(0, 0, False), (-1, 0, False), (64, 0, False), (0, -95, True),
+     (-256, 0, False), (0, -31, True)],
+)
+def test_band_tile_count_matches_tables(hi, lo, windowed, outer_is_q):
+    """The closed-form count used for the SMEM cap must equal the real
+    table length for every band shape (incl. empty/dummy rows)."""
+    from ring_attention_tpu.ops.pallas_flash import (
+        _band_tables,
+        _band_tile_count,
+    )
+
+    args = (4, 4, 64, 64, hi, lo, windowed, outer_is_q)
+    assert _band_tile_count(*args) == _band_tables(*args)[0].shape[0]
+
+
 def test_compact_table_cap_demotes_to_rectangular(rng, monkeypatch):
     """A static band whose tile tables exceed _MAX_COMPACT_TILES (SMEM
     scalar-prefetch budget) must silently take the rectangular grid and
