@@ -18,17 +18,21 @@ from ring_attention_tpu.parallel import create_mesh
 ATOL = 3e-5
 
 CASES = [
-    # (b, heads, kv_heads, n, dh, sp, striped, causal, softclamp, window)
-    (1, 2, 1, 37, 8, "ring", False, True, None, None),
-    (2, 4, 2, 96, 16, "ring", True, True, 5.0, None),
-    (1, 4, 4, 64, 8, "ring", False, True, None, 16),
-    (2, 4, 2, 80, 8, "ring", True, True, None, 24),
-    (1, 8, 8, 48, 8, "zigzag", False, True, None, None),
-    (2, 8, 4, 61, 16, "zigzag", False, True, 5.0, None),
-    (1, 8, 8, 72, 8, "ulysses", False, True, None, None),
-    (2, 16, 8, 56, 8, "ulysses", False, False, None, None),
-    (2, 4, 4, 33, 8, "ring", False, False, None, None),
-    (1, 8, 8, 40, 16, "ulysses", False, True, None, 12),
+    # (b, heads, kv_heads, n, dh, sp, striped, causal, softclamp, window, bidi)
+    (1, 2, 1, 37, 8, "ring", False, True, None, None, False),
+    (2, 4, 2, 96, 16, "ring", True, True, 5.0, None, False),
+    (1, 4, 4, 64, 8, "ring", False, True, None, 16, False),
+    (2, 4, 2, 80, 8, "ring", True, True, None, 24, False),
+    (1, 8, 8, 48, 8, "zigzag", False, True, None, None, False),
+    (2, 8, 4, 61, 16, "zigzag", False, True, 5.0, None, False),
+    (1, 8, 8, 72, 8, "ulysses", False, True, None, None, False),
+    (2, 16, 8, 56, 8, "ulysses", False, False, None, None, False),
+    (2, 4, 4, 33, 8, "ring", False, False, None, None, False),
+    (1, 8, 8, 40, 16, "ulysses", False, True, None, 12, False),
+    # bidirectional half-KV streams (even and odd-shard-fallback shapes)
+    (2, 4, 2, 96, 8, "ring", True, True, None, None, True),
+    (1, 4, 4, 64, 8, "ring", False, True, 5.0, None, True),
+    (2, 4, 2, 33, 8, "ring", False, False, None, None, True),  # odd: warns
 ]
 
 
@@ -39,7 +43,7 @@ def mesh():
 
 @pytest.mark.parametrize("case", CASES, ids=[f"case{i}" for i in range(len(CASES))])
 def test_fuzz_configs(mesh, case):
-    b, h, kvh, n, dh, sp, striped, causal, softclamp, window = case
+    b, h, kvh, n, dh, sp, striped, causal, softclamp, window, bidi = case
     rng = np.random.default_rng(zlib.crc32(repr(case).encode()))
     dim = h * dh
     common = dict(
@@ -48,12 +52,18 @@ def test_fuzz_configs(mesh, case):
     )
     sharded = RingAttention(
         use_ring=True, auto_shard=True, mesh=mesh, sequence_parallel=sp,
-        striped=striped, **common,
+        striped=striped, ring_bidirectional=bidi, **common,
     )
     oracle = RingAttention(use_ring=False, **common)
     x = jnp.asarray(rng.standard_normal((b, n, dim)), jnp.float32)
     params = oracle.init(jax.random.PRNGKey(0), x)
+    n_local = -(-n // 8)  # auto_shard pads n up to the ring multiple
+    if bidi and n_local % 2:
+        # odd shard: must fall back to unidirectional LOUDLY
+        with pytest.warns(UserWarning, match="ring_bidirectional requested"):
+            out = sharded.apply(params, x)
+    else:
+        out = sharded.apply(params, x)
     np.testing.assert_allclose(
-        sharded.apply(params, x), oracle.apply(params, x), atol=ATOL,
-        err_msg=str(case),
+        out, oracle.apply(params, x), atol=ATOL, err_msg=str(case),
     )
