@@ -64,6 +64,12 @@ class RingTransformer(nn.Module):
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
     # shard_map has no eager path)
     remat: bool = False
+    # remat refinement: "save_attn" additionally saves each layer's
+    # attention output + lse (the flash custom_vjp residuals, named in
+    # parallel/ring.py), so the backward skips re-running the O(n^2) ring
+    # scan — costing only (b, n, dim) + (b, h, n) saved activations per
+    # layer.  None = plain full-block remat.
+    remat_policy: str | None = None
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -71,8 +77,20 @@ class RingTransformer(nn.Module):
         # flax-lifted remat (NOT raw jax.checkpoint: param creation during
         # init is a side effect that would leak tracers out of the
         # checkpointed trace)
-        attn_cls = nn.remat(RingAttention) if self.remat else RingAttention
-        ff_cls = nn.remat(FeedForward) if self.remat else FeedForward
+        if self.remat:
+            assert self.remat_policy in (None, "save_attn"), self.remat_policy
+            policy = (
+                jax.checkpoint_policies.save_only_these_names(
+                    "ring_attn_out", "ring_attn_lse"
+                )
+                if self.remat_policy == "save_attn"
+                else None
+            )
+            attn_cls = nn.remat(RingAttention, policy=policy)
+            ff_cls = nn.remat(FeedForward)
+        else:
+            attn_cls = RingAttention
+            ff_cls = FeedForward
         self.attn_layers = [
             attn_cls(
                 dim=self.dim,

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -1134,7 +1135,11 @@ def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
         softclamp_value=softclamp_value, interpret=interpret,
     )
     out, lse = finalize_partials(parts)
-    return out.astype(q.dtype), lse
+    # named residuals: lets a remat policy save (out, lse) so the backward's
+    # residual recompute elides this kernel (see parallel/ring.py, same names)
+    out = checkpoint_name(out.astype(q.dtype), "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, lse
 
 
 def _pallas_flash_core_fwd(q, k, v, kv_mask, scale, causal_offset, window,

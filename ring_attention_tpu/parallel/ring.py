@@ -54,6 +54,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.flash import (
     attend_blocks,
@@ -397,7 +398,16 @@ def _ring_fwd_impl(
 
     (carry, _, _), _ = lax.scan(body, (carry, kvs, masks), jnp.arange(passes))
 
-    return final(carry)
+    out, lse = final(carry)
+    # Named so a selective remat policy can SAVE the attention output and
+    # lse (the custom_vjp residuals) — the backward's residual recompute
+    # then dead-code-eliminates this whole ring scan instead of running a
+    # second forward (RingTransformer(remat_policy="save_attn")).  The
+    # local (non-ring) flash paths use the same names (ops/flash.py,
+    # ops/pallas_flash.py).
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, lse
 
 
 def _ring_vjp_fwd(

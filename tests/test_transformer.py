@@ -171,6 +171,23 @@ def test_remat_parity(rng, mesh):
         np.testing.assert_allclose(a, b, atol=1e-5)
 
 
+def test_remat_save_attn_policy_parity(rng, mesh):
+    """remat_policy="save_attn" (saved flash residuals, no O(n^2) recompute
+    in the backward) must be value-identical to plain full-block remat."""
+    common = dict(num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+                  bucket_size=4, causal=True, striped=True, mesh=mesh,
+                  remat=True)
+    m1 = RingTransformer(**common)
+    m2 = RingTransformer(remat_policy="save_attn", **common)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 64)), jnp.int32)
+    params = m1.init(jax.random.PRNGKey(0), tokens)
+    l1, g1 = jax.jit(jax.value_and_grad(lambda p: m1.apply(p, tokens, return_loss=True)))(params)
+    l2, g2 = jax.jit(jax.value_and_grad(lambda p: m2.apply(p, tokens, return_loss=True)))(params)
+    np.testing.assert_allclose(l1, l2, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
 def test_variable_per_rank_batch(rng):
     """Variable per-rank batch through the model path (the reference's
     ``batch_size_var_len``, assert_attn.py:81-82 via distributed.py:58-84):
