@@ -81,7 +81,7 @@ class RingTransformer(nn.Module):
             assert self.remat_policy in (None, "save_attn"), self.remat_policy
             policy = (
                 jax.checkpoint_policies.save_only_these_names(
-                    "ring_attn_out", "ring_attn_lse"
+                    "flash_out", "flash_lse"
                 )
                 if self.remat_policy == "save_attn"
                 else None

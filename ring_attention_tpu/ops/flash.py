@@ -40,6 +40,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from .attention import MASK_VALUE, EPSILON, softclamp
 from ..utils.validate import check_attention_args
@@ -243,7 +244,12 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, bucket_size, causal_offset, window,
         window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
     )
     out_g, lse = finalize(carry)
-    return _ungroup(out_g).astype(q.dtype), lse
+    # named residuals: RingTransformer(remat_policy="save_attn") saves these
+    # so the backward's residual recompute elides the whole bucket scan
+    # (same names in parallel/ring.py and ops/pallas_flash.py)
+    out = checkpoint_name(_ungroup(out_g).astype(q.dtype), "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, lse
 
 
 def flash_backward_blocks(

@@ -67,3 +67,28 @@ def test_fuzz_configs(mesh, case):
     np.testing.assert_allclose(
         out, oracle.apply(params, x), atol=ATOL, err_msg=str(case),
     )
+
+
+def test_bidirectional_bucket_divides_full_but_not_half():
+    """Bucket divides the full shard but not the half-streams (n_local=12,
+    bucket=4): the per-stream refit in parallel/ring.py must fit the bucket
+    to the half length instead of tripping the XLA-path divisibility assert
+    (ADVICE r2).  Gradients covered too (backward shares the refit)."""
+    mesh = create_mesh(ring_size=8)
+    b, h, dh, n = 2, 4, 8, 96  # n_local = 12
+    common = dict(dim=h * dh, heads=h, dim_head=dh, causal=True, bucket_size=4)
+    sharded = RingAttention(
+        use_ring=True, auto_shard=True, mesh=mesh, striped=True,
+        ring_bidirectional=True, **common,
+    )
+    oracle = RingAttention(use_ring=False, **common)
+    rng = np.random.default_rng(12)
+    x = jnp.asarray(rng.standard_normal((b, n, h * dh)), jnp.float32)
+    params = oracle.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        sharded.apply(params, x), oracle.apply(params, x), atol=ATOL
+    )
+    g1 = jax.grad(lambda p: sharded.apply(p, x).astype(jnp.float32).sum())(params)
+    g2 = jax.grad(lambda p: oracle.apply(p, x).astype(jnp.float32).sum())(params)
+    for a, c in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, c, atol=1e-3)

