@@ -91,19 +91,31 @@ def _timed(chained_fn, args, iters):
     return timed_chained(chained_fn, args, iters)
 
 
-def _worker(impl: str, seq_len: int, mode: str) -> None:
-    """Runs one timed measurement and prints its own JSON line."""
+def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
+    """Runs one timed measurement and prints its own JSON line.
+
+    ``extra`` carries per-attempt config: heads / kv_heads / dim_head for
+    shape variants (GQA, wide head), remat_policy for the train step.
+    """
     import jax
     import jax.numpy as jnp
 
     if mode == "train":
-        _train_worker(impl, seq_len)
+        _train_worker(impl, seq_len, extra.get("remat_policy"))
         return
+    if mode == "hops":
+        _hops_worker(seq_len, int(extra.get("ring", 4)))
+        return
+
+    heads = int(extra.get("heads", HEADS))
+    kv_heads = int(extra.get("kv_heads", heads))
+    dim_head = int(extra.get("dim_head", DIM_HEAD))
 
     dev, peak = _device_peak()
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    shape = (1, HEADS, seq_len, DIM_HEAD)
-    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+    q = jax.random.normal(ks[0], (1, heads, seq_len, dim_head), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, kv_heads, seq_len, dim_head), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, kv_heads, seq_len, dim_head), jnp.bfloat16)
 
     attn = _attn_fn(impl, seq_len)
     iters = 3 if seq_len >= TARGET_SEQ else 10
@@ -143,7 +155,7 @@ def _worker(impl: str, seq_len: int, mode: str) -> None:
 
     compile_s, secs = _timed(chained, (q, k, v), iters)
 
-    flops = matmuls * 2 * seq_len * seq_len * HEADS * DIM_HEAD * 0.5  # causal
+    flops = matmuls * 2 * seq_len * seq_len * heads * dim_head * 0.5  # causal
     tflops = flops / secs / 1e12
     print(
         json.dumps(
@@ -155,6 +167,9 @@ def _worker(impl: str, seq_len: int, mode: str) -> None:
                 "vs_baseline": round(tflops / peak, 4),
                 "seq_len": seq_len,
                 "impl": impl,
+                "heads": heads,
+                "kv_heads": kv_heads,
+                "dim_head": dim_head,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),
@@ -163,8 +178,94 @@ def _worker(impl: str, seq_len: int, mode: str) -> None:
     )
 
 
-def _train_worker(impl: str, seq_len: int) -> None:
-    """Full train step (fwd+bwd+adam) tokens/sec on one chip."""
+def _hops_worker(seq_len: int, ring: int) -> None:
+    """Single-chip simulation of a causal ring's per-device hop sequence.
+
+    Runs the exact span calls device ``ring-1`` of a contiguous causal ring
+    makes (parallel/ring.py ``_ring_fwd_pallas``): hop 0 = compact diagonal
+    sweep seeding the carry, hops 1..R-2 = full sweeps resuming the carry
+    in-kernel, last hop = fused normalized write.  Validates that the
+    measured static-offset kernel rates survive on the path a real
+    multi-chip ring executes (VERDICT r2 missing #1 'done' criterion).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops.pallas_flash import (
+        pallas_flash_fused,
+        pallas_flash_partials,
+    )
+
+    dev, peak = _device_peak()
+    n_local = seq_len // ring
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, HEADS, n_local, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    scale = DIM_HEAD**-0.5
+
+    def hop_kv(i):  # device R-1's hop i holds origin (R-1-i)'s block
+        j = ring - 1 - i
+        sl = slice(j * n_local, (j + 1) * n_local)
+        return k[:, :, sl], v[:, :, sl]
+
+    def hop_sequence(q):
+        kh, vh = hop_kv(0)
+        carry = pallas_flash_partials(
+            q, kh, vh, scale=scale, causal_offset=0,
+            block_q=1024, block_k=1024,
+        )
+        for i in range(1, ring - 1):
+            kh, vh = hop_kv(i)
+            carry = pallas_flash_partials(  # fully-visible span, resumed
+                q, kh, vh, scale=scale, block_q=1024, block_k=1024,
+                carry=carry,
+            )
+        kh, vh = hop_kv(ring - 1)
+        out, _ = pallas_flash_fused(
+            q, kh, vh, scale=scale, block_q=1024, block_k=1024, carry=carry,
+        )
+        return out
+
+    iters = 3
+
+    @jax.jit
+    def chained(q):
+        def body(carry, _):
+            o = hop_sequence(carry)
+            return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+
+        out, ys = jax.lax.scan(body, q, None, length=iters)
+        return ys.astype(jnp.float32).sum()
+
+    compile_s, secs = _timed(chained, (q,), iters)
+    # hop 0 is half-masked; hops 1..R-1 are full n_local x n_local spans
+    flops = (
+        FWD_MATMULS * 2 * HEADS * DIM_HEAD * n_local * n_local * (ring - 0.5)
+    )
+    tflops = flops / secs / 1e12
+    print(
+        json.dumps(
+            {
+                "value": round(tflops, 4),
+                "vs_baseline": round(tflops / peak, 4),
+                "seq_len": seq_len,
+                "ring": ring,
+                "impl": "pallas-hops",
+                "device": getattr(dev, "device_kind", str(dev)),
+                "ms_per_step": round(secs * 1e3, 2),
+                "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
+def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
+    """Full train step (fwd+bwd+adam) tokens/sec on one chip.
+
+    ``remat_policy="save_attn"`` saves each layer's flash output + lse so
+    the backward skips re-running the O(n^2) attention forward (VERDICT r2
+    weak #1: the elective recompute cost the r2 headline ~2 s/step)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -183,6 +284,7 @@ def _train_worker(impl: str, seq_len: int) -> None:
         rotary=True,
         use_pallas=(impl == "pallas"),
         remat=True,
+        remat_policy=remat_policy,
         dtype=jnp.bfloat16,
     )
     # params are seq-independent: init on a short sequence to keep init cheap
@@ -225,6 +327,7 @@ def _train_worker(impl: str, seq_len: int) -> None:
                 "tokens_per_sec": round(seq_len / secs),
                 "train_seq_len": seq_len,
                 "train_impl": impl,
+                "train_remat_policy": remat_policy or "full",
                 "train_ms_per_step": round(secs * 1e3, 2),
                 "train_compile_s": round(compile_s, 1),
                 "train_loss": round(float(loss), 4),
@@ -234,13 +337,17 @@ def _train_worker(impl: str, seq_len: int) -> None:
     )
 
 
-def _run_attempt(impl: str, seq: int, mode: str, budget: float):
+def _run_attempt(impl: str, seq: int, mode: str, budget: float,
+                 extra: dict | None = None):
     """Subprocess-isolated measurement; returns parsed dict or error string."""
+    tag = f"{mode}:{impl}@{seq}" + (
+        f"[{','.join(f'{k}={v}' for k, v in extra.items())}]" if extra else ""
+    )
     try:
         proc = subprocess.run(
             [
                 sys.executable, os.path.abspath(__file__),
-                "--worker", impl, str(seq), mode,
+                "--worker", impl, str(seq), mode, json.dumps(extra or {}),
             ],
             capture_output=True,
             text=True,
@@ -249,11 +356,11 @@ def _run_attempt(impl: str, seq: int, mode: str, budget: float):
         )
         if proc.returncode == 0:
             return json.loads(proc.stdout.strip().splitlines()[-1]), None
-        return None, f"{mode}:{impl}@{seq}: rc={proc.returncode} {proc.stderr[-200:]}"
+        return None, f"{tag}: rc={proc.returncode} {proc.stderr[-200:]}"
     except subprocess.TimeoutExpired:
-        return None, f"{mode}:{impl}@{seq}: timeout"
+        return None, f"{tag}: timeout"
     except Exception:
-        return None, f"{mode}:{impl}@{seq}: {traceback.format_exc(limit=1)}"
+        return None, f"{tag}: {traceback.format_exc(limit=1)}"
 
 
 def main() -> None:
@@ -335,31 +442,96 @@ def main() -> None:
         else:
             log.append(err)
 
-    # phase 3 — train-step tokens/sec (fwd+bwd+adam), largest seq that fits
+    # phase 3 — train-step tokens/sec (fwd+bwd+adam), largest seq that
+    # fits; both remat variants (save_attn skips the backward's attention
+    # recompute and should lead — report both, headline the best)
     if best is not None:
         impl = best[0]
         train_seqs = []
         for s in (best[1], best[1] // 4, 8192):
             if s >= 1024 and s not in train_seqs:
                 train_seqs.append(s)
-        for seq in train_seqs:
-            if "tokens_per_sec" in result:
+        variants = {}  # policy label -> full worker payload (incl. its seq)
+        for policy in ("save_attn", None):
+            label = policy or "full"
+            for seq in train_seqs:
+                if label in variants:
+                    break
+                if not budget_left(1200):
+                    log.append(f"train:{impl}@{seq}: skipped (budget exhausted)")
+                    continue
+                payload, err = _run_attempt(
+                    impl, seq, "train", min(1200, deadline - time.monotonic()),
+                    {"remat_policy": policy},
+                )
+                if payload is not None:
+                    variants[label] = payload
+                    # per-variant keys carry their own seq so a fallback-
+                    # sized variant can never masquerade as the north star
+                    result[f"tokens_per_sec_{label}"] = payload["tokens_per_sec"]
+                    result[f"train_seq_len_{label}"] = payload["train_seq_len"]
+                    result[f"train_ms_per_step_{label}"] = payload[
+                        "train_ms_per_step"
+                    ]
+                    log.append(f"train:{impl}@{seq}[{label}]: ok")
+                else:
+                    log.append(err)
+        if variants:
+            # headline: largest measured seq wins; tokens/sec breaks ties
+            # (tokens/sec at a shorter seq is not comparable for O(n^2) work)
+            winner = max(
+                variants.values(),
+                key=lambda p: (p["train_seq_len"], p["tokens_per_sec"]),
+            )
+            result.update(winner)
+
+    # phase 4 — ring-hop sequence on one chip: the per-device span calls a
+    # real causal ring makes (resume + fused last hop).  Done criterion:
+    # >= 95% of the static single-sweep fwd rate (VERDICT r2 #1).
+    if got_target and budget_left(900):
+        payload, err = _run_attempt(
+            "pallas", TARGET_SEQ, "hops",
+            min(900, deadline - time.monotonic()), {"ring": 4},
+        )
+        if payload is not None:
+            result["ring_hops_tflops"] = payload["value"]
+            result["ring_hops_ms"] = payload["ms_per_step"]
+            if result.get("value"):
+                result["ring_hops_frac_of_fwd"] = round(
+                    payload["value"] / result["value"], 4
+                )
+            log.append(f"hops:pallas@{TARGET_SEQ}: ok")
+        else:
+            log.append(err)
+
+    # phase 5 — BASELINE.json config-4 GQA shape (heads=32, kv 4) and a
+    # d=128 variant.  h=32 x seq 262144 is a known relay 500 (memory:
+    # tpu-tunnel-operations); try it, fall back to 131072.
+    for extra, key, seqs in (
+        ({"heads": 32, "kv_heads": 4}, "gqa32_tflops", (TARGET_SEQ, 131072)),
+        ({"dim_head": 128}, "d128_tflops", (TARGET_SEQ, 131072)),
+    ):
+        for seq in seqs:
+            if key in result:
                 break
-            if not budget_left(1200):
-                log.append(f"train:{impl}@{seq}: skipped (budget exhausted)")
+            if not budget_left(900):
+                log.append(f"fwd:pallas@{seq}[{key}]: skipped (budget)")
                 continue
             payload, err = _run_attempt(
-                impl, seq, "train", min(1200, deadline - time.monotonic())
+                "pallas", seq, "fwd",
+                min(900, deadline - time.monotonic()), extra,
             )
             if payload is not None:
-                result.update(payload)
-                log.append(f"train:{impl}@{seq}: ok")
+                result[key] = payload["value"]
+                result[key.replace("_tflops", "_seq_len")] = seq
+                result[key.replace("_tflops", "_mfu")] = payload["vs_baseline"]
+                log.append(f"fwd:pallas@{seq}[{key}]: ok")
             else:
                 log.append(err)
 
     # keep the attempt trail even on success so a fallback-sized result is
     # never mistaken for a clean north-star run round-over-round
-    result["attempts"] = " | ".join(log)[-600:]
+    result["attempts"] = " | ".join(log)[-900:]
     if best is None:
         result["error"] = result["attempts"]
     print(json.dumps(result))
@@ -368,6 +540,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         mode = sys.argv[4] if len(sys.argv) > 4 else "fwd"
-        _worker(sys.argv[2], int(sys.argv[3]), mode)
+        extra = json.loads(sys.argv[5]) if len(sys.argv) > 5 else {}
+        _worker(sys.argv[2], int(sys.argv[3]), mode, extra)
     else:
         main()

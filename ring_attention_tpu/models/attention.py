@@ -75,6 +75,10 @@ class RingAttention(nn.Module):
     # applies when the local shard length is even, unidirectional with a
     # warning otherwise (odd shards only arise from padding edge cases)
     ring_bidirectional: bool = False
+    # dtype for the circulating dk/dv ring accumulators in the backward:
+    # None = float32 (exact); "bfloat16" halves backward ring bandwidth
+    # (ref ring_flash_attention_cuda.py:255-260) at bf16 round-off cost
+    ring_dkv_dtype: str | None = None
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -316,7 +320,7 @@ class RingAttention(nn.Module):
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self.use_pallas else "xla",
-                bidirectional,
+                bidirectional, self.ring_dkv_dtype,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
@@ -461,7 +465,7 @@ class RingAttention(nn.Module):
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self.use_pallas else "xla",
-                bidirectional,
+                bidirectional, self.ring_dkv_dtype,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)

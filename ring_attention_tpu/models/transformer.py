@@ -59,6 +59,7 @@ class RingTransformer(nn.Module):
     use_pallas: bool = False
     sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
     ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
+    ring_dkv_dtype: str | None = None  # see RingAttention.ring_dkv_dtype
     # rematerialize each block in backward: trades recompute for activation
     # memory — the standard recipe for quarter-million-token training.
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
@@ -110,6 +111,7 @@ class RingTransformer(nn.Module):
                 use_pallas=self.use_pallas,
                 sequence_parallel=self.sequence_parallel,
                 ring_bidirectional=self.ring_bidirectional,
+                ring_dkv_dtype=self.ring_dkv_dtype,
                 dtype=self.dtype,
             )
             for lookback in self._lookbacks()

@@ -1,12 +1,14 @@
 """Pallas (Mosaic) flash-attention kernels for TPU.
 
 TPU-native replacement for the reference's Triton kernels
-(``triton_flash_attn.py``): the forward emits the raw online-softmax
-partials ``(acc, m, l)`` so ring hops merge them exactly like the
-reference's ``LOAD_ACCUMULATED`` resume path (ref
-``triton_flash_attn.py:124-165``) — but as a pure-functional merge in XLA
-rather than mutating kernel state, which is the idiom XLA can pipeline
-with the ring ``ppermute``.
+(``triton_flash_attn.py``): the forward either emits the raw online-softmax
+partials ``(acc, m, l)`` for XLA-side merging, or — the ring-hop fast path —
+*continues* a carry in-kernel (``carry=...``, the reference's
+``LOAD_ACCUMULATED`` resume, ref ``triton_flash_attn.py:124-165``) and on
+the final span writes normalized ``q.dtype`` output + lse directly
+(``fused``, the reference's ``RETURN_NORMALIZED_OUTPUT``, ref
+``triton_flash_attn.py:273-275``), so the f32 accumulator triple never
+round-trips HBM between hops.
 
 Masking uses the same unified *banded causal offset* contract as
 ``ops/flash.py`` (attend iff ``lo <= j - i <= hi``: plain causal hi =
@@ -399,13 +401,17 @@ def _fwd_write(fused, outs, acc, m, l):
 
 
 def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
-                nk_blocks: int, **tile_kw):
+                resume: bool, nk_blocks: int, **tile_kw):
     """Unified forward kernel.
 
     Ref layout (pallas passes scalar-prefetch, inputs, outputs, scratch
     positionally; the static flags say which are present):
       scalars: offs (+ tq/tk/tf tile tables when ``compact``)
       inputs:  q, k, v (+ kv mask when ``masked``)
+               (+ carry acc/m/l when ``resume`` — the running online-softmax
+                state of previous ring hops, continued in-kernel exactly
+                like the reference's ``LOAD_ACCUMULATED`` resume, ref
+                ``triton_flash_attn.py:124-165``)
       outputs: (out, lse) when ``fused`` else (acc, m, l)
       scratch: acc (bq, d) f32, m (bq, 1) f32, l (bq, 1) f32
     """
@@ -421,6 +427,10 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
     idx += 3
     kvm_ref = refs[idx] if masked else None
     idx += 1 if masked else 0
+    carry_refs = None
+    if resume:
+        carry_refs = refs[idx:idx + 3]
+        idx += 3
     outs = refs[idx:idx + (2 if fused else 3)]
     acc, m, l = refs[idx + (2 if fused else 3):]
 
@@ -438,9 +448,14 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
 
     @pl.when(first)
     def _init():
-        acc[:] = jnp.zeros_like(acc)
-        m[:] = jnp.full_like(m, MASK_VALUE)
-        l[:] = jnp.zeros_like(l)
+        if resume:
+            acc[:] = carry_refs[0][0]
+            m[:] = carry_refs[1][0]
+            l[:] = carry_refs[2][0]
+        else:
+            acc[:] = jnp.zeros_like(acc)
+            m[:] = jnp.full_like(m, MASK_VALUE)
+            l[:] = jnp.zeros_like(l)
 
     tile = _tile_closure(_fwd_tile, tile_kw, offs_ref, q_ref, k_ref, v_ref,
                          kvm_ref, acc, m, l, row0, col0)
@@ -497,13 +512,17 @@ class FlashPartials(NamedTuple):
 def _flash_fwd_call(
     q, k, v, kv_mask, *,
     scale, causal_offset, window_lo, softclamp_value,
-    block_q, block_k, band_hint, interpret, fused,
+    block_q, block_k, band_hint, interpret, fused, carry=None,
 ):
     """Shared forward launcher: one flash sweep over a KV span.
 
     ``fused=False`` returns mergeable :class:`FlashPartials` (ring hops);
     ``fused=True`` returns ``(out in q.dtype, lse f32)`` with normalization
-    folded into the kernel's final write (no-merge callers)."""
+    folded into the kernel's final write (no-merge callers).  ``carry``
+    resumes a previous sweep's ``(acc, m, l)`` state in-kernel (the
+    reference's ``LOAD_ACCUMULATED``, ref ``triton_flash_attn.py:124-165``)
+    — one HBM read of the carry instead of an XLA-side
+    :func:`merge_partials` that reads both operands and writes a third."""
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
@@ -513,6 +532,7 @@ def _flash_fwd_call(
     causal = causal_offset is not None
     windowed = window_lo is not None and causal
     masked = kv_mask is not None
+    resume = carry is not None
 
     offs = jnp.asarray(
         [
@@ -578,6 +598,7 @@ def _flash_fwd_call(
         _fwd_kernel,
         compact=compact,
         fused=fused,
+        resume=resume,
         nk_blocks=nk // bk,
         **common,
     )
@@ -596,6 +617,18 @@ def _flash_fwd_call(
         kvm = kv_mask.astype(jnp.int8)
         in_specs.append(pl.BlockSpec((1, bk), kvm_map, memory_space=pltpu.VMEM))
         inputs.append(kvm)
+    if resume:
+        c_acc, c_m, c_l = (_unify_vma(x, q)[0] for x in carry)
+        inputs += [
+            c_acc.reshape(b * h, nq, d),
+            c_m.reshape(b * h, nq, 1),
+            c_l.reshape(b * h, nq, 1),
+        ]
+        in_specs += [
+            pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), q_map, memory_space=pltpu.VMEM),
+        ]
 
     if fused:
         out_specs = [
@@ -664,6 +697,7 @@ def pallas_flash_partials(
     block_q: int | None = None,
     block_k: int | None = None,
     band_hint: tuple[int, int, int, int] | None = None,
+    carry: FlashPartials | None = None,
     interpret: bool | None = None,
 ) -> FlashPartials:
     """One flash sweep over a KV span, returning mergeable partials.
@@ -671,13 +705,16 @@ def pallas_flash_partials(
     ``window_lo``: absolute band lower offset (see ``ops/flash.py``);
     may be a traced per-device scalar under SPMD.  ``band_hint`` supplies
     static band bounds for traced offsets so the compacted causal grid
-    still engages (see :func:`_normalize_hint`).
+    still engages (see :func:`_normalize_hint`).  ``carry`` continues a
+    previous sweep's online softmax in-kernel (ring hops) — equivalent to
+    ``merge_partials(carry, <this sweep>)`` without the XLA-side merge
+    traffic.
     """
     return _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
-        band_hint=band_hint, interpret=interpret, fused=False,
+        band_hint=band_hint, interpret=interpret, fused=False, carry=carry,
     )
 
 
@@ -693,23 +730,33 @@ def pallas_flash_fused(
     softclamp_value: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    band_hint: tuple[int, int, int, int] | None = None,
+    carry: FlashPartials | None = None,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Single-span forward with normalization fused into the final kernel
     write: returns ``(out in q.dtype, lse f32)`` directly.
 
-    For callers with no downstream partial merge (the local/non-ring path)
-    this replaces ``finalize_partials`` and skips materializing the f32
-    ``(acc, m, l)`` triple in HBM entirely (ref
-    ``triton_flash_attn.py:273-275`` fuses the same way).  No ``band_hint``:
-    a superset hint can leave band-empty rows holding masked garbage that
-    only a downstream merge would rescale away, and fused has none.
+    For callers with no downstream partial merge (the local/non-ring path,
+    or a ring's LAST hop via ``carry``) this replaces ``finalize_partials``
+    and skips materializing the f32 ``(acc, m, l)`` triple in HBM entirely
+    (ref ``triton_flash_attn.py:273-275`` fuses the same way, and
+    ``ring_flash_attention_cuda.py:134,182-186`` fuses it into the last
+    hop).  ``band_hint`` (superset bounds for traced offsets) requires a
+    ``carry``: a hint's superset-only tiles leave band-empty rows holding
+    masked garbage that only a rescale against real content can wipe —
+    with a carry the wipe happens in-kernel (by the ring's last hop every
+    row's carry holds its own-diagonal content), without one there is no
+    later merge to do it.
     """
+    assert band_hint is None or carry is not None, (
+        "pallas_flash_fused: band_hint needs a carry (see docstring)"
+    )
     return _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
-        band_hint=None, interpret=interpret, fused=True,
+        band_hint=band_hint, interpret=interpret, fused=True, carry=carry,
     )
 
 

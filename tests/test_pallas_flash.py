@@ -412,3 +412,40 @@ def test_backward_per_pass_block_sizes(rng, traced, masked):
                 block_q_dq=128, block_k_dq=32)
     for a, b, name in zip(base, split, ("dq", "dk", "dv")):
         np.testing.assert_allclose(a, b, atol=1e-4, err_msg=name)
+
+
+def test_carry_resume_matches_merge(rng):
+    """In-kernel accumulator resume (carry=...) must equal the XLA-side
+    merge_partials of two independent sweeps — the LOAD_ACCUMULATED
+    contract (ref triton_flash_attn.py:124-165) the ring hops rely on —
+    and resuming into a fused final write must equal finalizing that
+    merge (ref ring_flash_attention_cuda.py:134,182-186)."""
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_fused
+
+    q, k, v = make_qkv(rng, b=1, h=2, n=256, d=32)
+    scale = q.shape[-1] ** -0.5
+    left = pallas_flash_partials(
+        q, k[:, :, :128], v[:, :, :128], scale=scale,
+        block_q=64, block_k=64, interpret=True,
+    )
+    right = pallas_flash_partials(
+        q, k[:, :, 128:], v[:, :, 128:], scale=scale,
+        block_q=64, block_k=64, interpret=True,
+    )
+    merged = merge_partials(left, right)
+    resumed = pallas_flash_partials(
+        q, k[:, :, 128:], v[:, :, 128:], scale=scale,
+        block_q=64, block_k=64, carry=left, interpret=True,
+    )
+    # resume rescales the carry tile-by-tile where merge rescales once:
+    # same math, different summation order -> tiny float drift allowed
+    for a, b, name in zip(resumed, merged, ("acc", "m", "l")):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5, err_msg=name)
+
+    out_ref, lse_ref = finalize_partials(merged)
+    out, lse = pallas_flash_fused(
+        q, k[:, :, 128:], v[:, :, 128:], scale=scale,
+        block_q=64, block_k=64, carry=left, interpret=True,
+    )
+    np.testing.assert_allclose(out, out_ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(lse, lse_ref, atol=1e-5, rtol=1e-5)

@@ -440,3 +440,32 @@ def test_ring_determinism(rng, mesh):
     finally:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ring_dkv_bf16_circulation(rng, mesh, impl):
+    """dkv_dtype="bfloat16" halves the backward ring's ICI bandwidth (the
+    reference circulates half-precision dkv, ring_flash_attention_cuda.py:
+    255-260).  Accumulation suffers bf16 round-off per hop; grads must stay
+    within a bf16-scale tolerance of the exact f32 circulation."""
+    q, k, v = make_qkv(rng)
+
+    def loss(dkv_dtype):
+        def f(q, k, v):
+            return (
+                ring_attn_global(
+                    q, k, v, mesh=mesh, causal=True, bucket_size=16,
+                    impl=impl, dkv_dtype=dkv_dtype,
+                )
+                ** 2
+            ).sum()
+        return f
+
+    g_f32 = jax.grad(loss(None), (0, 1, 2))(q, k, v)
+    g_bf16 = jax.grad(loss("bfloat16"), (0, 1, 2))(q, k, v)
+    # dq never circulates: it must be bit-identical between the two modes
+    np.testing.assert_array_equal(g_bf16[0], g_f32[0])
+    # dk/dv accumulate in bf16 across 8 hops: relative error ~ bf16 eps
+    for a, b, name in zip(g_bf16[1:], g_f32[1:], "kv"):
+        np.testing.assert_allclose(a, b, atol=2e-2, rtol=2e-2,
+                                   err_msg=f"d{name}")

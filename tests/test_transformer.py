@@ -304,3 +304,23 @@ def test_transformer_sequence_parallel_modes(rng, mesh, sp):
     np.testing.assert_allclose(
         ring_model.apply(params, tokens), ref_model.apply(params, tokens), atol=ATOL
     )
+
+
+def test_ring_dkv_dtype_through_model(rng, mesh):
+    """ring_dkv_dtype="bfloat16" must reach the ring through the model
+    layer (the train-path consumer it exists for): loss matches the f32
+    circulation and grads stay finite and close."""
+    common = dict(num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+                  bucket_size=8, causal=True, striped=True, mesh=mesh)
+    m32 = RingTransformer(**common)
+    m16 = RingTransformer(ring_dkv_dtype="bfloat16", **common)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 64)), jnp.int32)
+    params = m32.init(jax.random.PRNGKey(0), tokens)
+    l32, g32 = jax.jit(jax.value_and_grad(
+        lambda p: m32.apply(p, tokens, return_loss=True)))(params)
+    l16, g16 = jax.jit(jax.value_and_grad(
+        lambda p: m16.apply(p, tokens, return_loss=True)))(params)
+    np.testing.assert_allclose(l16, l32, atol=1e-6)  # fwd identical
+    for a, b in zip(jax.tree.leaves(g16), jax.tree.leaves(g32)):
+        assert bool(jnp.isfinite(a).all())
+        np.testing.assert_allclose(a, b, atol=3e-2, rtol=3e-2)

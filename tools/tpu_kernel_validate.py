@@ -19,7 +19,12 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:  # prefer the installed package (pip install -e .)
+    import ring_attention_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout, any cwd
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
 
 
 def main() -> None:
