@@ -106,6 +106,9 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     if mode == "hops":
         _hops_worker(seq_len, int(extra.get("ring", 4)))
         return
+    if mode == "decode":
+        _decode_worker(impl, seq_len, extra)
+        return
 
     heads = int(extra.get("heads", HEADS))
     kv_heads = int(extra.get("kv_heads", heads))
@@ -255,6 +258,71 @@ def _hops_worker(seq_len: int, ring: int) -> None:
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
+def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
+    """Single-token decode latency against a ``seq_len``-token KV cache.
+
+    BASELINE config 5 (million-token context) is HBM-bandwidth-bound:
+    the cost of a decode step IS the KV read.  ``impl="pallas"`` =
+    ``pallas_flash_decode`` (cache read once per kv head);
+    ``impl="dense"`` = the dense ``default_attention`` tile (the r2
+    hardware-log path, 1.05 ms/token at 1M).  Reports ms/token and the
+    effective KV-read bandwidth."""
+    import jax
+    import jax.numpy as jnp
+
+    heads = int(extra.get("heads", HEADS))
+    kv_heads = int(extra.get("kv_heads", 2))
+    dev, _ = _device_peak()
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, heads, 1, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, kv_heads, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, kv_heads, seq_len, DIM_HEAD), jnp.bfloat16)
+    # live decode always carries a cache-validity mask (models/attention.py
+    # _decode_mask); include its read in the measurement
+    mask = jnp.ones((1, seq_len), jnp.bool_)
+
+    if impl == "pallas":
+        from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
+
+        def attend(q, k, v, mask):
+            out, _ = pallas_flash_decode(q, k, v, mask)
+            return out
+    else:
+        from ring_attention_tpu.ops.attention import default_attention
+
+        def attend(q, k, v, mask):
+            return default_attention(q, k, v, mask)
+
+    iters = 50
+
+    # k/v/mask as arguments, never closures: a jit-captured 537 MB cache
+    # becomes an embedded constant (the relay's HTTP 413 failure mode)
+    @jax.jit
+    def chained(q, k, v, mask):
+        def body(carry, _):
+            o = attend(carry, k, v, mask)
+            return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+
+        out, ys = jax.lax.scan(body, q, None, length=iters)
+        return ys.astype(jnp.float32).sum()
+
+    compile_s, secs = _timed(chained, (q, k, v, mask), iters)
+    kv_bytes = 2 * kv_heads * seq_len * DIM_HEAD * 2  # k+v, bf16
+    print(
+        json.dumps(
+            {
+                "decode_ms_per_token": round(secs * 1e3, 3),
+                "decode_kv_gbps": round(kv_bytes / secs / 1e9, 1),
+                "decode_seq_len": seq_len,
+                "decode_impl": impl,
+                "decode_kv_heads": kv_heads,
+                "decode_compile_s": round(compile_s, 1),
+                "device": getattr(dev, "device_kind", str(dev)),
             }
         )
     )
@@ -528,6 +596,26 @@ def main() -> None:
                 log.append(f"fwd:pallas@{seq}[{key}]: ok")
             else:
                 log.append(err)
+
+    # phase 6 — million-token decode (BASELINE config 5): ms/token against
+    # a 2^20-token GQA cache, decode kernel vs the dense tile
+    for impl in ("pallas", "dense"):
+        if not budget_left(600):
+            log.append(f"decode:{impl}: skipped (budget)")
+            continue
+        payload, err = _run_attempt(
+            impl, 1 << 20, "decode", min(600, deadline - time.monotonic())
+        )
+        if payload is not None:
+            suffix = "" if impl == "pallas" else "_dense"
+            for key in ("decode_ms_per_token", "decode_kv_gbps"):
+                result[key + suffix] = payload[key]
+            if impl == "pallas":
+                result["decode_seq_len"] = payload["decode_seq_len"]
+                result["decode_kv_heads"] = payload["decode_kv_heads"]
+            log.append(f"decode:{impl}@{1 << 20}: ok")
+        else:
+            log.append(err)
 
     # keep the attempt trail even on success so a fallback-sized result is
     # never mistaken for a clean north-star run round-over-round

@@ -18,6 +18,7 @@ from .ops import (
     default_attention,
     flash_attention,
     pallas_flash_attention,
+    pallas_flash_decode,
     ring_positions,
     rotary_freqs,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "default_attention",
     "flash_attention",
     "pallas_flash_attention",
+    "pallas_flash_decode",
     "ring_flash_attention",
     "ring_positions",
     "rotary_freqs",

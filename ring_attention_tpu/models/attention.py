@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import default_attention
 from ..ops.flash import flash_attention
-from ..ops.pallas_flash import pallas_flash_attention
+from ..ops.pallas_flash import pallas_flash_attention, pallas_flash_decode
 from ..ops.rotary import apply_rotary, ring_positions, rotary_freqs
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.ring import ring_flash_attention
@@ -372,10 +372,18 @@ class RingAttention(nn.Module):
             kv_mask = self._decode_mask(
                 jnp.arange(cache_k.shape[2]), pos, x.shape[0]
             )
-            out = default_attention(
-                q, cache_k, cache_v, kv_mask,
-                softclamp_value=self.softclamp_value,
-            )
+            if self.use_pallas:
+                # single-sweep decode kernel: each cache byte read once per
+                # kv head, normalized output written in-kernel
+                out, _ = pallas_flash_decode(
+                    q, cache_k, cache_v, kv_mask,
+                    softclamp_value=self.softclamp_value,
+                )
+            else:
+                out = default_attention(
+                    q, cache_k, cache_v, kv_mask,
+                    softclamp_value=self.softclamp_value,
+                )
         else:
             out, cache_k, cache_v = self._ring_decode(q, k, v, cache_k, cache_v, pos)
 
@@ -504,6 +512,7 @@ class RingAttention(nn.Module):
                 q, cache_k, cache_v, kv_mask,
                 axis_name=SEQ_AXIS,
                 softclamp_value=self.softclamp_value,
+                impl="pallas" if self.use_pallas else "xla",
             )
             return out, cache_k, cache_v
 
@@ -514,4 +523,5 @@ class RingAttention(nn.Module):
             mesh=self.mesh,
             in_specs=(rep, rep, rep, cspec, cspec, P()),
             out_specs=(rep, cspec, cspec),
+            check_vma=not self.use_pallas,
         )(q, k, v, cache_k, cache_v, pos)

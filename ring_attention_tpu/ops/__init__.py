@@ -7,11 +7,12 @@ from .flash import (
     flash_backward_blocks,
     init_carry,
 )
-from .pallas_flash import pallas_flash_attention
+from .pallas_flash import pallas_flash_attention, pallas_flash_decode
 from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
 
 __all__ = [
     "pallas_flash_attention",
+    "pallas_flash_decode",
     "default_attention",
     "softclamp",
     "MASK_VALUE",

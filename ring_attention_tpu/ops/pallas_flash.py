@@ -760,6 +760,72 @@ def pallas_flash_fused(
     )
 
 
+# Decode streams the whole KV cache once per kv head; large blocks amortize
+# grid steps and keep the DMA pipeline deep (2 x bk x d bf16 double-buffered
+# = 4 MB of VMEM at 8192x64 — well under budget).
+DEFAULT_BLOCK_DECODE = 8192
+
+
+def pallas_flash_decode(
+    q: jax.Array,  # (b, h, nq, d) — nq is tiny (typically 1)
+    k: jax.Array,  # (b, hk, nk, d)
+    v: jax.Array,  # (b, hk, nk, d)
+    kv_mask: jax.Array | None = None,  # (b, nk) bool, True = attend
+    *,
+    scale: float | None = None,
+    softclamp_value: float | None = None,
+    block_k: int | None = None,
+    fused: bool = True,
+    interpret: bool | None = None,
+):
+    """Decode-time flash attention: the KV cache is read once per *KV head*.
+
+    The training kernels grid over ``b*h`` query heads, so under GQA each
+    KV block is fetched ``g = h/hk`` times — irrelevant when compute
+    dominates, but decode (``nq`` ~ 1) is pure HBM bandwidth: the KV read
+    IS the cost.  Here the head group folds onto the query-row dimension
+    (``(b, h, nq, d) -> (b, hk, g*nq, d)``) and the sweep grids over
+    ``b*hk``, so every cache byte crosses HBM exactly once — the same
+    single-kernel decode the reference reaches for via its Triton path
+    (ref ``tree_attn_decoding.py:60-72``), minus its g-fold repeat
+    (ref ``tree_attn_decoding.py:47-52`` materializes grouped queries).
+
+    No causal band: decode queries attend the whole (masked) cache, like
+    the reference decode (ref ``tree_attn_decoding.py:23-103``); cache
+    validity (``[0, pos]``, lookback windows, ragged shards) is the
+    ``kv_mask``.
+
+    Returns:
+      ``fused=True``: ``(out (b, h, nq, d) in q.dtype, lse (b, h, nq) f32)``
+      — normalization in the kernel's final write; the single-device path.
+      ``fused=False``: raw ``(acc (b, hk, g, nq, d), m, l (b, hk, g, nq))``
+      f32 partials in the ``ops.flash.FlashCarry`` layout, for the
+      tree-decode cross-device merge (``parallel/tree_decode.py``).
+    """
+    b, h, nq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    if scale is None:
+        scale = d**-0.5
+    qf = q.reshape(b, hk, g * nq, d)
+    res = _flash_fwd_call(
+        qf, k, v, kv_mask,
+        scale=scale, causal_offset=None, window_lo=None,
+        softclamp_value=softclamp_value,
+        block_q=g * nq, block_k=block_k or DEFAULT_BLOCK_DECODE,
+        band_hint=None, interpret=interpret, fused=fused,
+    )
+    if fused:
+        out, lse = res
+        return out.reshape(b, h, nq, d), lse.reshape(b, h, nq)
+    acc, m, l = res
+    return (
+        acc.reshape(b, hk, g, nq, d),
+        m.reshape(b, hk, g, nq),
+        l.reshape(b, hk, g, nq),
+    )
+
+
 def init_partials(
     b: int, h: int, nq: int, d: int, like: jax.Array | None = None
 ) -> FlashPartials:

@@ -19,6 +19,7 @@ from jax import lax
 
 from ..ops.attention import EPSILON
 from ..ops.flash import attend_blocks, init_carry, _ungroup
+from ..ops.pallas_flash import pallas_flash_decode
 from ..utils.validate import check_attention_args
 
 
@@ -32,6 +33,7 @@ def tree_attn_decode(
     bucket_size: int | None = None,
     softclamp_value: float | None = None,
     scale: float | None = None,
+    impl: str = "xla",
 ) -> jax.Array:
     """Single(-few)-token decode attention; call inside ``shard_map``.
 
@@ -43,6 +45,11 @@ def tree_attn_decode(
         the static-shape answer to the reference's ragged "rank holds no KV"
         edge case (ref ``tree_attn_decoding.py:81-85``): pad the cache and
         mask the tail.
+      impl: local-partial compute path.  ``"xla"`` = blockwise jnp sweep;
+        ``"pallas"`` = :func:`~ring_attention_tpu.ops.pallas_flash.pallas_flash_decode`,
+        which reads each cache byte exactly once per kv head (decode is
+        HBM-bandwidth-bound; the training kernels re-fetch KV per query
+        head under GQA).
 
     Returns:
       ``(b, h, nq, d)`` decoded output, replicated across ``axis_name``.
@@ -55,13 +62,20 @@ def tree_attn_decode(
         scale = d**-0.5
 
     # local online-softmax partial over the KV shard
-    carry = init_carry(b, hk, g, nq, d, like=k)
-    carry = attend_blocks(
-        q, k, v, carry,
-        scale=scale, bucket_size=bucket_size, kv_mask=kv_mask,
-        softclamp_value=softclamp_value,
-    )
-    acc, m, l = carry
+    if impl == "pallas":
+        acc, m, l = pallas_flash_decode(
+            q, k, v, kv_mask,
+            scale=scale, softclamp_value=softclamp_value,
+            block_k=bucket_size, fused=False,
+        )
+    else:
+        carry = init_carry(b, hk, g, nq, d, like=k)
+        carry = attend_blocks(
+            q, k, v, carry,
+            scale=scale, bucket_size=bucket_size, kv_mask=kv_mask,
+            softclamp_value=softclamp_value,
+        )
+        acc, m, l = carry
 
     # three-collective merge (ref tree_attn_decoding.py:89-100)
     m_global = lax.pmax(m, axis_name)

@@ -56,6 +56,28 @@ def test_decode_matches_forward_local(rng, kv_heads):
     np.testing.assert_allclose(inc, full, atol=ATOL)
 
 
+@pytest.mark.parametrize("use_ring", [False, True])
+def test_decode_pallas_matches_forward(rng, use_ring):
+    """use_pallas decoding (the single-sweep decode kernel, interpret mode
+    on CPU) reproduces the full forward — locally and through the
+    tree-attention merge on the 8-ring."""
+    kw = dict(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, kv_heads=2,
+    )
+    model = RingTransformer(
+        use_pallas=True,
+        **(dict(kw, mesh=create_mesh(ring_size=8)) if use_ring
+           else dict(kw, use_ring=False)),
+    )
+    ref_model = RingTransformer(use_ring=False, **kw)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    full = ref_model.apply(params, tokens)
+    inc = _decode_all(model, params, tokens, max_len=16)
+    np.testing.assert_allclose(inc, full, atol=ATOL)
+
+
 def test_decode_matches_forward_ring(rng):
     """Cache sharded over an 8-ring; tree-attention merge per step."""
     mesh = create_mesh(ring_size=8)

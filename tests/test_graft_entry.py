@@ -42,8 +42,11 @@ def bench_records():
         "import jax; jax.config.update('jax_platforms', 'cpu')",
     ]
     # per-mode try/except so one mode's crash still reports the others
-    for mode in ("fwd", "fwdbwd", "train"):
-        argv = ["bench.py", "--worker", "xla", "1024", mode]
+    for mode, impl in (
+        ("fwd", "xla"), ("fwdbwd", "xla"), ("train", "xla"),
+        ("decode", "pallas"),
+    ):
+        argv = ["bench.py", "--worker", impl, "1024", mode]
         lines += [
             "try:",
             f"    sys.argv = {argv!r}",
@@ -67,8 +70,8 @@ def bench_records():
         json.loads(ln) for ln in proc.stdout.strip().splitlines()
         if ln.startswith("{")
     ]
-    assert len(recs) == 3, proc.stdout[-500:]
-    return dict(zip(("fwd", "fwdbwd", "train"), recs))
+    assert len(recs) == 4, proc.stdout[-500:]
+    return dict(zip(("fwd", "fwdbwd", "train", "decode"), recs))
 
 
 def test_bench_worker_contract(bench_records):
@@ -83,6 +86,14 @@ def test_bench_worker_fwdbwd(bench_records):
     north-star: BASELINE.md wants fwd AND training-relevant numbers)."""
     rec = bench_records["fwdbwd"]
     assert rec["value"] > 0 and rec["ms_per_step"] > 0
+
+
+def test_bench_worker_decode(bench_records):
+    """Million-token-decode mode (here at 1024): ms/token + effective
+    KV-read bandwidth via the decode kernel (interpret mode on CPU)."""
+    rec = bench_records["decode"]
+    assert rec["decode_ms_per_token"] > 0 and rec["decode_kv_gbps"] > 0
+    assert rec["decode_impl"] == "pallas"
 
 
 def test_bench_worker_train(bench_records):

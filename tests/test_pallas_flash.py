@@ -449,3 +449,43 @@ def test_carry_resume_matches_merge(rng):
     )
     np.testing.assert_allclose(out, out_ref, atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(lse, lse_ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("hk,nq", [(4, 1), (2, 1), (2, 3)])
+def test_decode_kernel_parity(rng, hk, nq):
+    """pallas_flash_decode (head group folded onto query rows, KV read once
+    per kv head) vs the dense oracle: fused output + lse, and the raw
+    FlashCarry-layout partials the tree merge consumes."""
+    from ring_attention_tpu.ops.flash import FlashCarry, finalize, _ungroup
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
+
+    b, h, n, d = 2, 4, 256, 32
+    q = jnp.asarray(rng.standard_normal((b, h, nq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.float32)
+    mask = jnp.asarray(rng.random((b, n)) < 0.8)
+    ref = default_attention(q, k, v, mask)
+
+    out, lse = pallas_flash_decode(q, k, v, mask, block_k=64, interpret=True)
+    assert out.shape == q.shape and lse.shape == (b, h, nq)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+    acc, m, l = pallas_flash_decode(
+        q, k, v, mask, block_k=64, fused=False, interpret=True
+    )
+    assert acc.shape == (b, hk, h // hk, nq, d)
+    o2, _ = finalize(FlashCarry(acc, m, l))
+    np.testing.assert_allclose(_ungroup(o2), ref, atol=ATOL)
+
+
+def test_decode_kernel_softclamp(rng):
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
+
+    b, h, hk, n, d = 1, 4, 2, 128, 32
+    q, k, v = make_qkv(rng, b=b, h=h, hk=hk, n=n, d=d)
+    q = q[:, :, :1]
+    ref = default_attention(q, k, v, softclamp_value=15.0)
+    out, _ = pallas_flash_decode(
+        q, k, v, softclamp_value=15.0, block_k=32, interpret=True
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
