@@ -365,12 +365,13 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
         jax.random.PRNGKey(1), (1, seq_len + 1), 0, 256, jnp.int32
     )
 
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: model.apply(p, tokens, return_loss=True)
-        )(params)
-        updates, opt_state = opt.update(grads, opt_state)
-        return optax.apply_updates(params, updates), opt_state, loss
+    from ring_attention_tpu.utils import make_train_step
+
+    # the framework's own composed step (utils/train.py) — the bench
+    # measures the API users actually call
+    step = make_train_step(
+        lambda p, t: model.apply(p, t, return_loss=True), opt
+    )
 
     iters = 3 if seq_len >= 65536 else 5
 

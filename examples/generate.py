@@ -28,6 +28,9 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=32)
     ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--use-pallas", action="store_true",
+                    help="decode with the pallas decode kernel (each cache "
+                         "byte read once per kv head; interpret mode on CPU)")
     args = ap.parse_args()
 
     if args.prompt_len + args.steps - 1 > args.max_len:
@@ -55,6 +58,7 @@ def main() -> None:
     model = RingTransformer(
         num_tokens=256, dim=128, depth=2, heads=4, dim_head=32,
         causal=True, bucket_size=64, mesh=mesh, use_ring=mesh is not None,
+        use_pallas=args.use_pallas,
     )
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, 256, (1, args.prompt_len)), jnp.int32)

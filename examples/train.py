@@ -32,6 +32,8 @@ def main() -> None:
     ap.add_argument("--depth", type=int, default=2)
     ap.add_argument("--ring-size", type=int, default=None)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--accum-steps", type=int, default=1,
+                    help="gradient-accumulation microbatches per update")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize blocks (activation memory savings)")
     ap.add_argument("--use-pallas", action="store_true",
@@ -54,7 +56,7 @@ def main() -> None:
     import optax
 
     from ring_attention_tpu import RingTransformer, create_mesh
-    from ring_attention_tpu.utils import StepTimer
+    from ring_attention_tpu.utils import StepTimer, make_train_step
 
     n_dev = len(jax.devices())
     ring = args.ring_size or n_dev
@@ -89,13 +91,10 @@ def main() -> None:
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)
 
-    @jax.jit
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: model.apply(p, tokens, return_loss=True)
-        )(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    train_step = jax.jit(make_train_step(
+        lambda p, t: model.apply(p, t, return_loss=True), opt,
+        accum_steps=args.accum_steps,
+    ))
 
     timer = StepTimer(tokens_per_step=tokens.size)
     for step in range(args.steps):
