@@ -56,6 +56,7 @@ def main() -> None:
     import optax
 
     from ring_attention_tpu import RingTransformer, create_mesh
+    from ring_attention_tpu.parallel import shard_batch
     from ring_attention_tpu.utils import StepTimer, make_train_step
 
     n_dev = len(jax.devices())
@@ -87,6 +88,10 @@ def main() -> None:
         np.concatenate([base, base], axis=1), jnp.int32
     )
 
+    if mesh is not None:
+        # batch over data, sequence over the ring - no host-side gather
+        # (multi-host: each process passes its local slice)
+        tokens = shard_batch(tokens, mesh)
     params = model.init(jax.random.PRNGKey(0), tokens)
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)

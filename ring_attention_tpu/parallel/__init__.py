@@ -14,6 +14,7 @@ from .mesh import (
     initialize_multihost,
     replicated,
     seq_sharding,
+    shard_batch,
 )
 from .ring import ring_flash_attention
 from .tree_decode import tree_attn_decode
@@ -45,6 +46,7 @@ __all__ = [
     "initialize_multihost",
     "replicated",
     "seq_sharding",
+    "shard_batch",
     "ring_flash_attention",
     "tree_attn_decode",
     "ulysses_attention",

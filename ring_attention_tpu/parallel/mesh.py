@@ -85,3 +85,39 @@ def seq_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host-local token batch onto the ``(data, seq)`` mesh.
+
+    Single-process (one host owns every device): a plain ``device_put``
+    with :func:`seq_sharding`.  Multi-host (after
+    :func:`initialize_multihost`): each process passes only ITS local
+    slice of the global batch and the pieces assemble into one global
+    array via ``jax.make_array_from_process_local_data`` — the dataloader
+    never materializes the full global batch on any host, which at ring
+    scale is the difference between feeding a 2^20-token sequence and
+    OOMing the coordinator.  (The reference gathers the full batch onto
+    every rank instead: ``all_gather`` in
+    ``sharded_batch_to_sharded_seq``, ref ``ring_attention.py:223-262``.)
+
+    Works on pytrees: leaves of rank >= 2 get batch over ``data`` and
+    sequence over ``seq``; rank-1 leaves shard over ``data`` only;
+    scalars replicate.
+    """
+    def place(x):
+        # host-side ndarray: device_put / make_array_from_process_local_data
+        # then transfer each shard directly, never staging the full array
+        # through one device's HBM
+        x = np.asarray(x)
+        if x.ndim >= 2:
+            sharding = seq_sharding(mesh)
+        elif x.ndim == 1:
+            sharding = NamedSharding(mesh, P(DATA_AXIS))
+        else:
+            sharding = replicated(mesh)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(place, batch)
