@@ -84,14 +84,15 @@ def main() -> None:
     rng = np.random.default_rng(0)
     # synthetic "copy task" data: predictable structure so loss falls fast
     base = rng.integers(0, 256, (args.batch, args.seq_len // 2))
-    tokens = jnp.asarray(
-        np.concatenate([base, base], axis=1), jnp.int32
-    )
+    tokens = np.concatenate([base, base], axis=1).astype(np.int32)
 
     if mesh is not None:
-        # batch over data, sequence over the ring - no host-side gather
-        # (multi-host: each process passes its local slice)
+        # host array straight onto the mesh: batch over data, sequence over
+        # the ring, one per-shard transfer (multi-host: each process passes
+        # its local slice)
         tokens = shard_batch(tokens, mesh)
+    else:
+        tokens = jnp.asarray(tokens)
     params = model.init(jax.random.PRNGKey(0), tokens)
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)

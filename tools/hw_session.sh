@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Round-3 hardware session: run the pending measurements serially, one TPU
+# client at a time (docs/hardware_log.md "Tunnel pathology"), each with its
+# own budget.  Run AFTER a health probe succeeds:
+#
+#   timeout 120 python -c "import jax; print(jax.devices()[0].device_kind)"
+#   bash tools/hw_session.sh           # logs to /tmp/hw_r3_*.log
+#
+# Steps (VERDICT r2 items #1 done-criterion at 262k, #5, #6 + decode):
+#   1. validate --sweep          parity + fwd/fwdbwd re-baseline   (~5 min)
+#   2. hops @262k ring=4         900 s+ compile budget             (~15 min)
+#   3. validate --bwd-sweep      per-pass backward block sweep     (~20 min)
+#   4. decode 2^20 pallas/dense  ms/token + KV GB/s                (~10 min)
+#   5. GQA 32/4 + d128 fwd       BASELINE config-4 shapes          (~15 min)
+# Full bench.py is NOT here: the driver runs it at round end.
+set -u
+cd "$(dirname "$0")/.."
+
+run() {  # run <tag> <budget_s> <cmd...>
+  local tag=$1 budget=$2; shift 2
+  echo "=== $tag (budget ${budget}s) ==="
+  timeout "$budget" "$@" > "/tmp/hw_r3_${tag}.log" 2>&1
+  local rc=$?
+  tail -5 "/tmp/hw_r3_${tag}.log"
+  echo "=== $tag rc=$rc ==="
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    # a killed relay compile wedges the far-side grant (hardware_log.md
+    # "Tunnel pathology"); every later step would hang through its full
+    # budget against a dead tunnel — stop the session instead
+    echo "ABORT: $tag was killed at its budget; tunnel grant is likely" \
+         "wedged — probe health before running anything else" >&2
+    exit 124
+  fi
+}
+
+run validate 900  python tools/tpu_kernel_validate.py --sweep --seq 262144
+run hops262k 1500 python bench.py --worker pallas 262144 hops '{"ring": 4}'
+run bwdsweep 1800 python tools/tpu_kernel_validate.py --bwd-sweep --seq 262144
+run decode_pallas 700 python bench.py --worker pallas 1048576 decode '{}'
+run decode_dense 700 python bench.py --worker dense 1048576 decode '{}'
+run gqa32 900 python bench.py --worker pallas 131072 fwd '{"heads": 32, "kv_heads": 4}'
+run d128 900 python bench.py --worker pallas 131072 fwd '{"dim_head": 128}'
+echo "session done; logs: /tmp/hw_r3_*.log"
