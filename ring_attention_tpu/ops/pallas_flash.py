@@ -808,21 +808,33 @@ def pallas_flash_decode(
     if scale is None:
         scale = d**-0.5
     qf = q.reshape(b, hk, g * nq, d)
+    # pad query rows up to one sublane tile: Mosaic handles tiny row
+    # blocks unevenly across generations, and the pad rows cost nothing
+    # against a bandwidth-bound sweep (zero queries -> uniform weights ->
+    # finite outputs, sliced away below)
+    rows = g * nq
+    min_rows = 16 if q.dtype == jnp.bfloat16 else 8
+    pad = (-rows) % min_rows
+    if pad:
+        qf = jnp.pad(qf, [(0, 0), (0, 0), (0, pad), (0, 0)])
     res = _flash_fwd_call(
         qf, k, v, kv_mask,
         scale=scale, causal_offset=None, window_lo=None,
         softclamp_value=softclamp_value,
-        block_q=g * nq, block_k=block_k or DEFAULT_BLOCK_DECODE,
+        block_q=rows + pad, block_k=block_k or DEFAULT_BLOCK_DECODE,
         band_hint=None, interpret=interpret, fused=fused,
     )
     if fused:
         out, lse = res
-        return out.reshape(b, h, nq, d), lse.reshape(b, h, nq)
+        return (
+            out[:, :, :rows].reshape(b, h, nq, d),
+            lse[:, :, :rows].reshape(b, h, nq),
+        )
     acc, m, l = res
     return (
-        acc.reshape(b, hk, g, nq, d),
-        m.reshape(b, hk, g, nq),
-        l.reshape(b, hk, g, nq),
+        acc[:, :, :rows].reshape(b, hk, g, nq, d),
+        m[:, :, :rows].reshape(b, hk, g, nq),
+        l[:, :, :rows].reshape(b, hk, g, nq),
     )
 
 

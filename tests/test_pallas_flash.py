@@ -489,3 +489,20 @@ def test_decode_kernel_softclamp(rng):
         q, k, v, softclamp_value=15.0, block_k=32, interpret=True
     )
     np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+def test_decode_kernel_bf16_row_padding(rng):
+    """bf16 decode pads query rows to a full sublane tile (16); results
+    must be unchanged and pad rows invisible."""
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
+
+    b, h, hk, n, d = 1, 2, 2, 128, 32  # rows = g*nq = 1 -> pad to 16
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.bfloat16)
+    ref = default_attention(q, k, v)
+    out, lse = pallas_flash_decode(q, k, v, block_k=32, interpret=True)
+    assert out.shape == (b, h, 1, d) and lse.shape == (b, h, 1)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32), atol=2e-2
+    )
