@@ -286,21 +286,62 @@ class RingTransformer(nn.Module):
         prompt: jax.Array,  # (b, n) int32
         max_len: int,
         num_steps: int,
+        *,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        rng: jax.Array | None = None,
     ) -> jax.Array:
-        """Greedy generation: one prefill pass over the prompt, then emit
-        ``num_steps`` new tokens.  Returns ``(b, num_steps)``."""
+        """One prefill pass over the prompt, then emit ``num_steps`` new
+        tokens.  Returns ``(b, num_steps)``.
+
+        The decode loop is a single ``nn.scan`` with the KV cache as carry,
+        so the jitted program holds ONE decode-step body regardless of
+        ``num_steps`` (compile time is O(1) in generation length, not O(n)
+        as a Python loop of traced steps would be).
+
+        ``temperature == 0.0`` (default) is greedy argmax; otherwise
+        categorical sampling at the given temperature, optionally truncated
+        to the ``top_k`` highest-probability tokens, driven by ``rng``
+        (which must then be provided).
+        """
         b, n = prompt.shape
         assert n >= 1, "generate needs a non-empty prompt"
         assert num_steps >= 1, "generate needs num_steps >= 1"
         assert n + num_steps - 1 <= max_len, "cache too small for prompt + steps"
+        if temperature > 0.0 and rng is None:
+            raise ValueError("generate: temperature > 0 needs an rng key")
+        if rng is None:  # unused (greedy) but keeps the carry pytree uniform
+            rng = jax.random.PRNGKey(0)
+
+        def sample(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if top_k is not None:
+                kth = lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(
+                key, logits.astype(jnp.float32) / temperature, axis=-1
+            ).astype(jnp.int32)
+
         cache = self.init_cache(b, max_len)
         logits, cache = self.prefill(prompt, cache)
-        outs = []
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        for j in range(num_steps):
-            outs.append(tok)
-            if j == num_steps - 1:
-                break
-            logits, cache = self.decode_step(tok, cache, jnp.int32(n + j))
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jnp.stack(outs, axis=1)
+        rng, key = jax.random.split(rng)
+        tok = sample(logits, key)
+        if num_steps == 1:
+            return tok[:, None]
+
+        def body(mdl, carry, _):
+            tok, cache, pos, rng = carry
+            logits, cache = mdl.decode_step(tok, cache, pos)
+            rng, key = jax.random.split(rng)
+            nxt = sample(logits, key)
+            return (nxt, cache, pos + 1, rng), nxt
+
+        scan = nn.scan(
+            body,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            length=num_steps - 1,
+        )
+        _, rest = scan(self, (tok, cache, jnp.int32(n), rng), None)
+        return jnp.concatenate([tok[:, None], rest.T], axis=1)

@@ -749,9 +749,12 @@ def pallas_flash_fused(
     row's carry holds its own-diagonal content), without one there is no
     later merge to do it.
     """
-    assert band_hint is None or carry is not None, (
-        "pallas_flash_fused: band_hint needs a carry (see docstring)"
-    )
+    if band_hint is not None and carry is None:
+        # not an assert: violating this silently yields uniform-weight
+        # garbage for band-empty rows, and asserts vanish under python -O
+        raise ValueError(
+            "pallas_flash_fused: band_hint needs a carry (see docstring)"
+        )
     return _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
@@ -813,7 +816,9 @@ def pallas_flash_decode(
     # against a bandwidth-bound sweep (zero queries -> uniform weights ->
     # finite outputs, sliced away below)
     rows = g * nq
-    min_rows = 16 if q.dtype == jnp.bfloat16 else 8
+    # one sublane tile is 32 / itemsize rows (8 for f32, 16 for bf16/f16,
+    # 32 for one-byte dtypes) — key on itemsize, not a bf16 check
+    min_rows = max(8, 32 // jnp.dtype(q.dtype).itemsize)
     pad = (-rows) % min_rows
     if pad:
         qf = jnp.pad(qf, [(0, 0), (0, 0), (0, pad), (0, 0)])

@@ -70,6 +70,7 @@ from ..ops.flash import (
     _ungroup,
 )
 from ..ops.pallas_flash import (
+    _block_sizes,
     finalize_partials,
     pallas_flash_backward,
     pallas_flash_fused,
@@ -257,6 +258,29 @@ def _fit_bucket(bucket_size: int | None, nk: int) -> int | None:
     return b
 
 
+def _pallas_blocks(bucket_size, nq, nk):
+    """Pallas-path analogue of :func:`_fit_bucket`'s visibility guarantee.
+
+    The kernels' ``_block_sizes`` silently halves a block by powers of two
+    until it divides the span — correct, but on a bidirectional half-stream
+    whose length isn't divisible it's a silent perf cliff while the XLA
+    path warns via ``_fit_bucket``.  Mirror the demotion here (shapes are
+    static) and emit the same refit warning when a block lands at <= half
+    of what was asked for."""
+    if bucket_size is None:
+        return None, None
+    bq, bk = _block_sizes(nq, nk, bucket_size, bucket_size)
+    if bq * 2 <= min(bucket_size, nq) or bk * 2 <= min(bucket_size, nk):
+        warnings.warn(
+            f"ring pallas blocks demoted from {bucket_size} to "
+            f"(block_q={bq}, block_k={bk}) to divide the ({nq}, {nk}) span; "
+            f"tiny blocks underfill the MXU — pick a bucket_size dividing "
+            f"the (half-)shard length",
+            stacklevel=2,
+        )
+    return bq, bk
+
+
 def _span_ops(q, hk, scale, bucket_size, softclamp_value):
     """Per-hop (init, attend, final) for the XLA compute path.
 
@@ -289,11 +313,12 @@ def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
               bucket_size, softclamp_value, hk, band_hint=None):
     """Per-hop backward: returns (dq (b,h,..), dk (b,hk,..), dv (b,hk,..))."""
     if impl == "pallas":
+        bq, bk = _pallas_blocks(bucket_size, q.shape[2], k.shape[2])
         return pallas_flash_backward(
             do, q, k, v, lse, delta, kv_mask,
             scale=scale, causal_offset=hi, window_lo=lo,
             softclamp_value=softclamp_value,
-            block_q=bucket_size, block_k=bucket_size,
+            block_q=bq, block_k=bk,
             band_hint=band_hint,
         )
     return flash_backward_blocks(
@@ -349,23 +374,29 @@ def _ring_fwd_pallas(
             if full:
                 hi, lo, hint = None, None, None
 
-            def partials(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint):
+            blk_q, blk_k = _pallas_blocks(
+                bucket_size, q.shape[2], kvx[0].shape[2]
+            )
+
+            def partials(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
+                         blk_q=blk_q, blk_k=blk_k):
                 return pallas_flash_partials(
                     q, kvx[0], kvx[1], mx,
                     scale=scale, causal_offset=hi, window_lo=lo,
                     softclamp_value=softclamp_value,
-                    block_q=bucket_size, block_k=bucket_size,
+                    block_q=blk_q, block_k=blk_k,
                     band_hint=hint, carry=c,
                 )
 
             if span == n_spans - 1:
 
-                def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint):
+                def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
+                         blk_q=blk_q, blk_k=blk_k):
                     return pallas_flash_fused(
                         q, kvx[0], kvx[1], mx,
                         scale=scale, causal_offset=hi, window_lo=lo,
                         softclamp_value=softclamp_value,
-                        block_q=bucket_size, block_k=bucket_size,
+                        block_q=blk_q, block_k=blk_k,
                         # hint only rides along with a carry (see
                         # pallas_flash_fused); by the last hop every row's
                         # carry holds its own-diagonal content

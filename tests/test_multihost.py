@@ -41,28 +41,36 @@ def test_two_process_cluster_trains():
         )
         for pid in range(2)
     ]
-    outs = []
+    # outputs keyed by worker index so a partial timeout can't misattribute
+    # one worker's log to another (ADVICE r3)
+    outs: dict[int, str] = {}
     try:
-        for p in procs:
+        for pid, p in enumerate(procs):
             out, _ = p.communicate(timeout=420)
-            outs.append(out)
+            outs[pid] = out
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
         # reap and collect the stuck workers' partial output — that is
         # the log that explains the hang
-        for p in procs:
-            if p.returncode is None or len(outs) < len(procs):
+        for pid, p in enumerate(procs):
+            if pid not in outs:
                 try:
                     out, _ = p.communicate(timeout=10)
-                    outs.append(out)
+                    outs[pid] = out
                 except Exception:
                     pass
-        pytest.fail("multihost workers timed out\n" + "\n".join(outs))
-    for pid, (p, out) in enumerate(zip(procs, outs)):
+        pytest.fail(
+            "multihost workers timed out\n"
+            + "\n".join(f"--- worker {pid} ---\n{out}"
+                        for pid, out in sorted(outs.items()))
+        )
+    for pid, p in enumerate(procs):
+        out = outs[pid]
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-2000:]}"
         assert f"MULTIHOST-OK {pid}" in out, out[-2000:]
     # both processes computed the SAME replicated loss
-    losses = {ln.split("loss=")[1] for out in outs for ln in out.splitlines()
+    losses = {ln.split("loss=")[1]
+              for out in outs.values() for ln in out.splitlines()
               if "MULTIHOST-OK" in ln}
     assert len(losses) == 1, losses

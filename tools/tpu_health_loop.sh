@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Background TPU tunnel health probe loop.  Appends one line per probe to
+# /tmp/tpu_health.log.  A probe IS a TPU client, so before starting any
+# real TPU work: kill this loop (pkill -f tpu_health_loop), then confirm
+# no probe is in flight (pgrep -f tpu-health-probe-inner), THEN start.
+#
+# The probe itself holds a lockfile while running so an operator can also
+# check /tmp/tpu_probe.lock.
+set -u
+INTERVAL=${1:-600}
+while true; do
+  touch /tmp/tpu_probe.lock
+  ts=$(date -u +%H:%M:%S)
+  # the trailing comment tags the probe's argv for pgrep; no pipe here so
+  # $? is the probe's own exit status (124 = timeout = wedged)
+  out=$(timeout 120 python -c "import jax; print(jax.devices()[0].device_kind)  # tpu-health-probe-inner" 2>/dev/null)
+  rc=$?
+  rm -f /tmp/tpu_probe.lock
+  if [ "$rc" -eq 0 ]; then
+    echo "$ts HEALTHY ${out##*$'\n'}" >> /tmp/tpu_health.log
+  else
+    echo "$ts WEDGED rc=$rc" >> /tmp/tpu_health.log
+  fi
+  sleep "$INTERVAL"
+done
