@@ -54,13 +54,15 @@ FWD_MATMULS = 2
 FWDBWD_MATMULS = 7
 
 
-def _attn_fn(impl: str, seq_len: int):
+def _attn_fn(impl: str, seq_len: int, head_chunks: int | None = None):
     from functools import partial
 
     if impl == "pallas":
         from ring_attention_tpu.ops.pallas_flash import pallas_flash_attention
 
-        return partial(pallas_flash_attention, causal=True)
+        return partial(
+            pallas_flash_attention, causal=True, head_chunks=head_chunks
+        )
     from ring_attention_tpu.ops.flash import flash_attention
 
     bucket = min(1024, seq_len)
@@ -100,6 +102,10 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     import jax
     import jax.numpy as jnp
 
+    from ring_attention_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+
     if mode == "train":
         _train_worker(impl, seq_len, extra.get("remat_policy"))
         return
@@ -113,6 +119,7 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     heads = int(extra.get("heads", HEADS))
     kv_heads = int(extra.get("kv_heads", heads))
     dim_head = int(extra.get("dim_head", DIM_HEAD))
+    head_chunks = extra.get("head_chunks")
 
     dev, peak = _device_peak()
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -120,7 +127,9 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     k = jax.random.normal(ks[1], (1, kv_heads, seq_len, dim_head), jnp.bfloat16)
     v = jax.random.normal(ks[2], (1, kv_heads, seq_len, dim_head), jnp.bfloat16)
 
-    attn = _attn_fn(impl, seq_len)
+    attn = _attn_fn(
+        impl, seq_len, int(head_chunks) if head_chunks else None
+    )
     iters = 3 if seq_len >= TARGET_SEQ else 10
 
     if mode == "fwdbwd":
@@ -173,6 +182,7 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
                 "heads": heads,
                 "kv_heads": kv_heads,
                 "dim_head": dim_head,
+                **({"head_chunks": int(head_chunks)} if head_chunks else {}),
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),

@@ -31,6 +31,11 @@ def main() -> None:
     ap.add_argument("--use-pallas", action="store_true",
                     help="decode with the pallas decode kernel (each cache "
                          "byte read once per kv head; interpret mode on CPU)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sample with this temperature via the scan-based "
+                         "generate() (0 = greedy token-by-token streaming)")
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None)
     args = ap.parse_args()
 
     if args.prompt_len + args.steps - 1 > args.max_len:
@@ -63,6 +68,22 @@ def main() -> None:
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, 256, (1, args.prompt_len)), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), prompt)
+
+    if args.temperature > 0.0:
+        # whole loop as ONE compiled scan (models/transformer.py generate)
+        t0 = time.perf_counter()
+        out = model.apply(
+            params, prompt, args.max_len, args.steps,
+            method=RingTransformer.generate,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, rng=jax.random.PRNGKey(1),
+        )
+        dt = time.perf_counter() - t0
+        toks = [int(t) for t in np.asarray(out[0])]
+        print(f"devices={n_dev}  sampled {len(toks)} tokens in one "
+              f"compile+scan ({len(toks) / dt:.1f} tok/s incl. compile)")
+        print("tokens:", toks)
+        return
 
     # prefill once, then jit one decode step and stream
     cache = model.apply(params, 1, args.max_len, method=RingTransformer.init_cache)

@@ -289,6 +289,7 @@ class RingTransformer(nn.Module):
         *,
         temperature: float = 0.0,
         top_k: int | None = None,
+        top_p: float | None = None,
         rng: jax.Array | None = None,
     ) -> jax.Array:
         """One prefill pass over the prompt, then emit ``num_steps`` new
@@ -300,9 +301,10 @@ class RingTransformer(nn.Module):
         as a Python loop of traced steps would be).
 
         ``temperature == 0.0`` (default) is greedy argmax; otherwise
-        categorical sampling at the given temperature, optionally truncated
-        to the ``top_k`` highest-probability tokens, driven by ``rng``
-        (which must then be provided).
+        categorical sampling at the given temperature, truncated to the
+        ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
+        (smallest probability mass >= top_p), driven by ``rng`` (which must
+        then be provided).
         """
         b, n = prompt.shape
         assert n >= 1, "generate needs a non-empty prompt"
@@ -310,18 +312,34 @@ class RingTransformer(nn.Module):
         assert n + num_steps - 1 <= max_len, "cache too small for prompt + steps"
         if temperature > 0.0 and rng is None:
             raise ValueError("generate: temperature > 0 needs an rng key")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"generate: top_p must be in (0, 1], got {top_p}")
         if rng is None:  # unused (greedy) but keeps the carry pytree uniform
             rng = jax.random.PRNGKey(0)
 
         def sample(logits, key):
             if temperature <= 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # temperature first: the nucleus must be computed on the SAME
+            # distribution that is sampled (the standard ordering)
+            logits = logits.astype(jnp.float32) / temperature
             if top_k is not None:
                 kth = lax.top_k(logits, top_k)[0][..., -1:]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
-            return jax.random.categorical(
-                key, logits.astype(jnp.float32) / temperature, axis=-1
-            ).astype(jnp.int32)
+            if top_p is not None:
+                # nucleus: keep the smallest prefix of descending-prob
+                # tokens whose mass reaches top_p (always >= 1 token, since
+                # each token's threshold tests the mass *before* it and
+                # top_p > 0 is validated above)
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                mass_before = jnp.cumsum(probs, axis=-1) - probs
+                cut = jnp.sum(mass_before < top_p, axis=-1, keepdims=True)
+                thresh = jnp.take_along_axis(sorted_logits, cut - 1, axis=-1)
+                logits = jnp.where(logits < thresh, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(
+                jnp.int32
+            )
 
         cache = self.init_cache(b, max_len)
         logits, cache = self.prefill(prompt, cache)

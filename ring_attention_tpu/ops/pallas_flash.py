@@ -1427,12 +1427,23 @@ def pallas_flash_attention(
     window: int | None = None,
     softclamp_value: float | None = None,
     scale: float | None = None,
+    head_chunks: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Exact flash attention on the Pallas TPU kernel path (GQA-aware).
 
     Same contract as ``ops.flash.flash_attention``; parity-tested against
     the oracle.  On non-TPU backends runs the kernels in interpreter mode.
+
+    ``head_chunks`` splits the launch into that many kernel calls over
+    contiguous head groups (GQA groups stay aligned: chunk ``i`` holds q
+    heads ``[i*h/c, (i+1)*h/c)`` against kv heads ``[i*hk/c, (i+1)*hk/c)``).
+    Each chunk is an independent pallas program — fwd AND bwd via the
+    per-chunk custom_vjp — so a shape whose single-program compile blows a
+    compiler/relay size limit (observed: h=32 at seq 262144 on the v5e
+    remote-compile relay) still runs at full rate, paying only c-1 extra
+    kernel launches.  Heads are embarrassingly parallel in attention, so
+    outputs are bit-identical to the unsplit launch.
     """
     check_attention_args("pallas_flash_attention", q, k, v, mask)
     if scale is None:
@@ -1442,7 +1453,27 @@ def pallas_flash_attention(
     if causal:
         mask = None
     causal_offset = k.shape[2] - q.shape[2] if causal else None
+    interpret = interpret if interpret is not None else _interpret_default()
+    if head_chunks is not None and head_chunks > 1:
+        h, hk = q.shape[1], k.shape[1]
+        if h % head_chunks or hk % head_chunks:
+            raise ValueError(
+                f"pallas_flash_attention: head_chunks={head_chunks} must "
+                f"divide both heads={h} and kv_heads={hk}"
+            )
+        hq_c, hk_c = h // head_chunks, hk // head_chunks
+        outs = [
+            _pallas_flash_core(
+                q[:, i * hq_c:(i + 1) * hq_c],
+                k[:, i * hk_c:(i + 1) * hk_c],
+                v[:, i * hk_c:(i + 1) * hk_c],
+                mask, scale, causal_offset, window, softclamp_value,
+                interpret,
+            )
+            for i in range(head_chunks)
+        ]
+        return jnp.concatenate(outs, axis=1)
     return _pallas_flash_core(
         q, k, v, mask, scale, causal_offset, window, softclamp_value,
-        interpret if interpret is not None else _interpret_default(),
+        interpret,
     )

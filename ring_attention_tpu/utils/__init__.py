@@ -1,6 +1,6 @@
 from .checkpoint import restore_checkpoint, save_checkpoint
 from .profiling import StepTimer, trace
-from .benchtime import fetch_rtt, timed_chained
+from .benchtime import enable_compile_cache, fetch_rtt, timed_chained
 from .train import make_train_step, shard_optimizer_state
 from .validate import check_attention_args, check_model_input, check_tokens_input
 

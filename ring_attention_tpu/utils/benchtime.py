@@ -19,10 +19,33 @@ These helpers implement that recipe; ``bench.py`` builds on them.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Sequence
 
-__all__ = ["fetch_rtt", "timed_chained"]
+__all__ = ["enable_compile_cache", "fetch_rtt", "timed_chained"]
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str:
+    """Point jax's persistent executable cache at ``cache_dir`` (default:
+    ``.jax_cache_tpu/`` in the repo root).
+
+    On the flaky TPU tunnel, long relay compiles are the wedge risk
+    (``docs/hardware_log.md``): with the cache, each program's compile
+    only has to succeed ONCE across worker subprocesses and resumed
+    hardware sessions.  Shared by ``bench.py`` and the ``tools/``
+    hardware scripts so they all hit one cache."""
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_cache_tpu",
+        )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return cache_dir
 
 
 def fetch_rtt(samples: int = 3) -> float:

@@ -169,6 +169,19 @@ def test_generate_sampling(rng):
         method=RingTransformer.generate, temperature=0.5, top_k=1,
     )
     np.testing.assert_array_equal(top1, greedy)
+    # a tiny nucleus similarly collapses to greedy (the top token's
+    # mass-before is always 0 < top_p, so exactly it survives)
+    nucleus = model.apply(
+        params, prompt, 32, 8, rng=key,
+        method=RingTransformer.generate, temperature=0.7, top_p=1e-9,
+    )
+    np.testing.assert_array_equal(nucleus, greedy)
+    # permissive nucleus: valid tokens, deterministic under the key
+    p9 = model.apply(
+        params, prompt, 32, 8, rng=key,
+        method=RingTransformer.generate, temperature=1.0, top_p=0.9,
+    )
+    assert ((p9 >= 0) & (p9 < VOCAB)).all()
 
     with pytest.raises(ValueError):
         model.apply(

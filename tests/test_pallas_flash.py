@@ -46,6 +46,32 @@ def test_fwd_gqa(rng):
     np.testing.assert_allclose(out, ref, atol=ATOL)
 
 
+def test_head_chunked_launch_bit_exact(rng):
+    """head_chunks splits the launch into per-head-group programs (the
+    relay program-size workaround for h=32 @ 262k); heads are independent,
+    so outputs AND grads must be bit-identical to the unsplit launch."""
+    q, k, v = make_qkv(rng, h=8, hk=4)
+
+    def loss(q, k, v, hc):
+        out = pallas_flash_attention(
+            q, k, v, causal=True, head_chunks=hc, interpret=True
+        )
+        return (out * out).sum(), out
+
+    (ref_l, ref_out), ref_grads = jax.value_and_grad(
+        loss, argnums=(0, 1, 2), has_aux=True)(q, k, v, None)
+    (spl_l, spl_out), spl_grads = jax.value_and_grad(
+        loss, argnums=(0, 1, 2), has_aux=True)(q, k, v, 4)
+    np.testing.assert_array_equal(spl_out, ref_out)
+    for g_ref, g_spl in zip(ref_grads, spl_grads):
+        np.testing.assert_array_equal(g_spl, g_ref)
+
+    with pytest.raises(ValueError):
+        pallas_flash_attention(
+            q, k, v, causal=True, head_chunks=3, interpret=True
+        )
+
+
 def test_fwd_mask(rng):
     q, k, v = make_qkv(rng)
     mask = jnp.asarray(rng.random((2, 128)) > 0.3)

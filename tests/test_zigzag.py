@@ -70,6 +70,19 @@ def test_zigzag_parity(rng, mesh):
     np.testing.assert_allclose(out, ref, atol=ATOL)
 
 
+def test_zigzag_kv_budget_warning(rng, mesh):
+    """The O(n_global) gathered-KV profile warns when it exceeds the
+    budget, and points at the ring scheme (VERDICT r3 weak #6)."""
+    import warnings as w
+
+    q, k, v = make_qkv(rng)
+    with w.catch_warnings():
+        w.simplefilter("error")  # default budget: must NOT warn at 128 tokens
+        zigzag_global(q, k, v, mesh)
+    with pytest.warns(UserWarning, match="sequence_parallel='ring'"):
+        zigzag_global(q, k, v, mesh, gathered_kv_budget=1024)
+
+
 def test_zigzag_gqa_bucketed(rng, mesh):
     q, k, v = make_qkv(rng, h=4, hk=2)
     ref = default_attention(q, k, v, causal=True)

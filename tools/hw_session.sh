@@ -1,27 +1,47 @@
 #!/usr/bin/env bash
-# Round-3 hardware session: run the pending measurements serially, one TPU
-# client at a time (docs/hardware_log.md "Tunnel pathology"), each with its
-# own budget.  Run AFTER a health probe succeeds:
+# Round-4 hardware session: run the pending measurements serially, one TPU
+# client at a time (docs/hardware_log.md "Tunnel pathology").
 #
-#   timeout 120 python -c "import jax; print(jax.devices()[0].device_kind)"
-#   bash tools/hw_session.sh           # logs to /tmp/hw_r3_*.log
+# Wedge-aware AND resumable:
+#   - per-step logs land in docs/hwlogs/ (in-repo, survive the session)
+#   - completed steps are recorded in docs/hwlogs/done.txt and skipped on
+#     re-run, so a mid-session wedge doesn't void finished work
+#   - tunnel health is probed (120 s) before every step; a failed probe
+#     aborts the session instead of burning every remaining budget
+#   - a step killed at its budget aborts the session: a killed relay
+#     compile wedges the far-side grant for hours (hardware_log.md)
 #
-# Steps (VERDICT r2 items #1 done-criterion at 262k, #5, #6 + decode):
-#   1. validate --sweep          parity + fwd/fwdbwd re-baseline   (~5 min)
-#   2. hops @262k ring=4         900 s+ compile budget             (~15 min)
-#   3. validate --bwd-sweep      per-pass backward block sweep     (~20 min)
-#   4. decode 2^20 pallas/dense  ms/token + KV GB/s                (~10 min)
-#   5. GQA 32/4 + d128 fwd       BASELINE config-4 shapes          (~15 min)
-# Full bench.py is NOT here: the driver runs it at round end.
+# Usage:
+#   pkill -f tpu_health_loop; sleep 1; pgrep -f tpu-health-probe-inner && exit
+#   bash tools/hw_session.sh          # runs all pending steps
+#   bash tools/hw_session.sh hops262k # run just one step (ignores done.txt)
 set -u
 cd "$(dirname "$0")/.."
+LOGDIR=docs/hwlogs
+DONE=$LOGDIR/done.txt
+mkdir -p "$LOGDIR"
+touch "$DONE"
+ONLY=${1:-}
+
+probe() {
+  timeout -k 30 120 python -c "import jax; print(jax.devices()[0].device_kind)  # tpu-health-probe-inner" >/dev/null 2>&1
+}
 
 run() {  # run <tag> <budget_s> <cmd...>
   local tag=$1 budget=$2; shift 2
+  if [ -n "$ONLY" ] && [ "$tag" != "$ONLY" ]; then return 0; fi
+  if [ -z "$ONLY" ] && grep -qx "$tag" "$DONE"; then
+    echo "=== $tag already done, skipping ==="
+    return 0
+  fi
+  if ! probe; then
+    echo "ABORT before $tag: health probe hung — tunnel is wedged" >&2
+    exit 125
+  fi
   echo "=== $tag (budget ${budget}s) ==="
-  timeout "$budget" "$@" > "/tmp/hw_r3_${tag}.log" 2>&1
+  timeout -k 30 "$budget" "$@" > "$LOGDIR/${tag}.log" 2>&1
   local rc=$?
-  tail -5 "/tmp/hw_r3_${tag}.log"
+  tail -5 "$LOGDIR/${tag}.log"
   echo "=== $tag rc=$rc ==="
   if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
     # a killed relay compile wedges the far-side grant (hardware_log.md
@@ -31,13 +51,33 @@ run() {  # run <tag> <budget_s> <cmd...>
          "wedged — probe health before running anything else" >&2
     exit 124
   fi
+  if [ "$rc" -eq 0 ]; then echo "$tag" >> "$DONE"; fi
 }
 
+# --- round-4 pending measurements (VERDICT r3 next #1-#6) ---------------
+# 1. re-baseline: parity + fwd/fwdbwd at the north star
 run validate 900  python tools/tpu_kernel_validate.py --sweep --seq 262144
-run hops262k 1500 python bench.py --worker pallas 262144 hops '{"ring": 4}'
-run bwdsweep 1800 python tools/tpu_kernel_validate.py --bwd-sweep --seq 262144
+# 2. hop-sequence at 262k — needs the 900s+ compile budget (4 kernel
+#    programs in one jit); r2 done-criterion at the north-star length
+run hops262k 1800 python bench.py --worker pallas 262144 hops '{"ring": 4}'
+# 3. decode kernel's FIRST real Mosaic run (+ dense comparison point)
 run decode_pallas 700 python bench.py --worker pallas 1048576 decode '{}'
-run decode_dense 700 python bench.py --worker dense 1048576 decode '{}'
-run gqa32 900 python bench.py --worker pallas 131072 fwd '{"heads": 32, "kv_heads": 4}'
-run d128 900 python bench.py --worker pallas 131072 fwd '{"dim_head": 128}'
-echo "session done; logs: /tmp/hw_r3_*.log"
+run decode_dense  700 python bench.py --worker dense  1048576 decode '{}'
+# 4. backward block sweep -> pin block_*_dkv / block_*_dq defaults
+run bwdsweep 1800 python tools/tpu_kernel_validate.py --bwd-sweep --seq 262144
+# 5. train headline, both remat variants (save_attn expected >30k tok/s)
+run train_save 1200 python bench.py --worker pallas 262144 train '{"remat_policy": "save_attn"}'
+run train_full 1200 python bench.py --worker pallas 262144 train '{}'
+# 6. BASELINE config-4 shapes: GQA 32/4 and d128 (131072 = known-good,
+#    262144 = the full shape via the head-split launch)
+run gqa32      900 python bench.py --worker pallas 131072 fwd '{"heads": 32, "kv_heads": 4}'
+# full config-4 shape: the single-program compile 500s at h=32 x 262k,
+# so split the launch over the 4 kv-head groups (ops/pallas_flash.py
+# head_chunks); also grab the fwdbwd number
+run gqa32_262k 1500 python bench.py --worker pallas 262144 fwd '{"heads": 32, "kv_heads": 4, "head_chunks": 4}'
+run gqa32_262k_bwd 1800 python bench.py --worker pallas 262144 fwdbwd '{"heads": 32, "kv_heads": 4, "head_chunks": 4}'
+run d128       900 python bench.py --worker pallas 131072 fwd '{"dim_head": 128}'
+run d128_262k  1500 python bench.py --worker pallas 262144 fwd '{"dim_head": 128}'
+# 7. first real XProf capture: MXU/VPU/DMA split for the next MFU push
+run xprof 900 python tools/xprof_capture.py
+echo "session done; logs in $LOGDIR/ (done steps: $(tr '\n' ' ' < "$DONE"))"

@@ -13,7 +13,7 @@ while true; do
   ts=$(date -u +%H:%M:%S)
   # the trailing comment tags the probe's argv for pgrep; no pipe here so
   # $? is the probe's own exit status (124 = timeout = wedged)
-  out=$(timeout 120 python -c "import jax; print(jax.devices()[0].device_kind)  # tpu-health-probe-inner" 2>/dev/null)
+  out=$(timeout -k 30 120 python -c "import jax; print(jax.devices()[0].device_kind)  # tpu-health-probe-inner" 2>/dev/null)
   rc=$?
   rm -f /tmp/tpu_probe.lock
   if [ "$rc" -eq 0 ]; then

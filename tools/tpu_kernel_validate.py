@@ -43,6 +43,12 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from ring_attention_tpu.utils import enable_compile_cache
+
+    # persistent executable cache: a long relay compile only has to
+    # succeed once across sessions (docs/hardware_log.md wedge pathology)
+    enable_compile_cache()
+
     from ring_attention_tpu.ops.attention import default_attention
     from ring_attention_tpu.ops.pallas_flash import (
         finalize_partials,
