@@ -182,7 +182,10 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
                 "heads": heads,
                 "kv_heads": kv_heads,
                 "dim_head": dim_head,
-                **({"head_chunks": int(head_chunks)} if head_chunks else {}),
+                # head_chunks only applies to the pallas launcher; don't
+                # record it on impls where _attn_fn drops it
+                **({"head_chunks": int(head_chunks)}
+                   if head_chunks and impl == "pallas" else {}),
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),
@@ -296,11 +299,14 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
     # _decode_mask); include its read in the measurement
     mask = jnp.ones((1, seq_len), jnp.bool_)
 
+    block_k = extra.get("block_k")
     if impl == "pallas":
         from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
 
         def attend(q, k, v, mask):
-            out, _ = pallas_flash_decode(q, k, v, mask)
+            out, _ = pallas_flash_decode(
+                q, k, v, mask, block_k=int(block_k) if block_k else None
+            )
             return out
     else:
         from ring_attention_tpu.ops.attention import default_attention
@@ -331,6 +337,8 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
                 "decode_seq_len": seq_len,
                 "decode_impl": impl,
                 "decode_kv_heads": kv_heads,
+                **({"decode_block_k": int(block_k)}
+                   if impl == "pallas" and block_k else {}),
                 "decode_compile_s": round(compile_s, 1),
                 "device": getattr(dev, "device_kind", str(dev)),
             }

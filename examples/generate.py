@@ -38,6 +38,9 @@ def main() -> None:
     ap.add_argument("--top-p", type=float, default=None)
     args = ap.parse_args()
 
+    if args.temperature <= 0.0 and (args.top_k is not None
+                                    or args.top_p is not None):
+        ap.error("--top-k/--top-p need --temperature > 0 (sampling mode)")
     if args.prompt_len + args.steps - 1 > args.max_len:
         ap.error(
             f"--max-len {args.max_len} too small for prompt {args.prompt_len} "

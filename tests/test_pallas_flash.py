@@ -517,18 +517,24 @@ def test_decode_kernel_softclamp(rng):
     np.testing.assert_allclose(out, ref, atol=ATOL)
 
 
-def test_decode_kernel_bf16_row_padding(rng):
-    """bf16 decode pads query rows to a full sublane tile (16); results
+@pytest.mark.parametrize("dtype,atol", [
+    (jnp.bfloat16, 2e-2),  # itemsize 2 -> sublane tile 16 rows
+    (jnp.float16, 2e-2),   # itemsize 2 -> 16 (the pre-ADVICE code padded 8)
+    (jnp.float32, 1e-5),   # itemsize 4 -> 8
+])
+def test_decode_kernel_row_padding(rng, dtype, atol):
+    """Decode pads query rows to a full sublane tile, keyed on dtype
+    itemsize (ADVICE r3: an exact-bf16 check under-padded f16); results
     must be unchanged and pad rows invisible."""
     from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
 
-    b, h, hk, n, d = 1, 2, 2, 128, 32  # rows = g*nq = 1 -> pad to 16
-    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.bfloat16)
-    k = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.bfloat16)
-    v = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.bfloat16)
+    b, h, hk, n, d = 1, 2, 2, 128, 32  # rows = g*nq = 1 -> pad to tile
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, hk, n, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, hk, n, d)), dtype)
     ref = default_attention(q, k, v)
     out, lse = pallas_flash_decode(q, k, v, block_k=32, interpret=True)
     assert out.shape == (b, h, 1, d) and lse.shape == (b, h, 1)
     np.testing.assert_allclose(
-        out.astype(jnp.float32), ref.astype(jnp.float32), atol=2e-2
+        out.astype(jnp.float32), ref.astype(jnp.float32), atol=atol
     )

@@ -60,9 +60,14 @@ run validate 900  python tools/tpu_kernel_validate.py --sweep --seq 262144
 # 2. hop-sequence at 262k — needs the 900s+ compile budget (4 kernel
 #    programs in one jit); r2 done-criterion at the north-star length
 run hops262k 1800 python bench.py --worker pallas 262144 hops '{"ring": 4}'
-# 3. decode kernel's FIRST real Mosaic run (+ dense comparison point)
+# 3. decode kernel's FIRST real Mosaic run (+ dense comparison point);
+#    then a small block_k sweep around the 8192 default (bandwidth-bound:
+#    deeper DMA pipelining may beat it)
 run decode_pallas 700 python bench.py --worker pallas 1048576 decode '{}'
 run decode_dense  700 python bench.py --worker dense  1048576 decode '{}'
+run decode_bk16k  500 python bench.py --worker pallas 1048576 decode '{"block_k": 16384}'
+run decode_bk32k  500 python bench.py --worker pallas 1048576 decode '{"block_k": 32768}'
+run decode_bk4k   500 python bench.py --worker pallas 1048576 decode '{"block_k": 4096}'
 # 4. backward block sweep -> pin block_*_dkv / block_*_dq defaults
 run bwdsweep 1800 python tools/tpu_kernel_validate.py --bwd-sweep --seq 262144
 # 5. train headline, both remat variants (save_attn expected >30k tok/s)
