@@ -120,6 +120,11 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     kv_heads = int(extra.get("kv_heads", heads))
     dim_head = int(extra.get("dim_head", DIM_HEAD))
     head_chunks = extra.get("head_chunks")
+    if head_chunks and impl != "pallas":
+        # fail fast: a sweep step must not silently measure the default
+        # config in a scarce hardware window
+        raise ValueError(f"head_chunks only applies to impl='pallas', "
+                         f"got impl={impl!r}")
 
     dev, peak = _device_peak()
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -300,6 +305,9 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
     mask = jnp.ones((1, seq_len), jnp.bool_)
 
     block_k = extra.get("block_k")
+    if block_k and impl != "pallas":
+        raise ValueError(f"decode block_k only applies to impl='pallas', "
+                         f"got impl={impl!r}")
     if impl == "pallas":
         from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
 

@@ -66,6 +66,11 @@ class RingAttention(nn.Module):
     auto_shard: bool = False
     mesh: Mesh | None = None
     use_pallas: bool = False
+    # split the (non-ring) pallas launch into this many per-head-group
+    # kernel programs — bit-identical results; the escape hatch for
+    # compiler/relay program-size limits at large heads x seq (see
+    # ops/pallas_flash.py pallas_flash_attention)
+    pallas_head_chunks: int | None = None
     # context-parallel scheme over the seq mesh axis:
     #   "ring"    — KV rotation (+ striped load balance); the reference's core
     #   "zigzag"  — Llama-3 chunk pairing + all-gathered KV (causal only)
@@ -197,6 +202,7 @@ class RingAttention(nn.Module):
             return pallas_flash_attention(
                 q, k, v, mask, causal=self.causal, window=window,
                 softclamp_value=self.softclamp_value,
+                head_chunks=self.pallas_head_chunks,
             )
         return flash_attention(
             q, k, v, mask, causal=self.causal, bucket_size=self.bucket_size,

@@ -57,6 +57,8 @@ class RingTransformer(nn.Module):
     auto_shard: bool = True
     mesh: Mesh | None = None
     use_pallas: bool = False
+    # see RingAttention.pallas_head_chunks (program-size escape hatch)
+    pallas_head_chunks: int | None = None
     sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
     ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
     ring_dkv_dtype: str | None = None  # see RingAttention.ring_dkv_dtype
@@ -109,6 +111,7 @@ class RingTransformer(nn.Module):
                 auto_shard=False,  # sharded once at model top
                 mesh=self.mesh,
                 use_pallas=self.use_pallas,
+                pallas_head_chunks=self.pallas_head_chunks,
                 sequence_parallel=self.sequence_parallel,
                 ring_bidirectional=self.ring_bidirectional,
                 ring_dkv_dtype=self.ring_dkv_dtype,
@@ -312,6 +315,11 @@ class RingTransformer(nn.Module):
         assert n + num_steps - 1 <= max_len, "cache too small for prompt + steps"
         if temperature > 0.0 and rng is None:
             raise ValueError("generate: temperature > 0 needs an rng key")
+        if temperature <= 0.0 and (top_k is not None or top_p is not None):
+            raise ValueError(
+                "generate: top_k/top_p need temperature > 0 (greedy mode "
+                "would silently ignore them)"
+            )
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"generate: top_p must be in (0, 1], got {top_p}")
         if rng is None:  # unused (greedy) but keeps the carry pytree uniform

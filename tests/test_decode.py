@@ -188,6 +188,17 @@ def test_generate_sampling(rng):
             params, prompt, 32, 4,
             method=RingTransformer.generate, temperature=1.0,
         )
+    # greedy mode must reject sampling knobs rather than ignore them
+    with pytest.raises(ValueError):
+        model.apply(
+            params, prompt, 32, 4,
+            method=RingTransformer.generate, top_k=5,
+        )
+    with pytest.raises(ValueError):
+        model.apply(
+            params, prompt, 32, 4, rng=key,
+            method=RingTransformer.generate, temperature=1.0, top_p=0.0,
+        )
 
 
 @pytest.mark.slow
