@@ -52,6 +52,15 @@ from ..utils.validate import check_attention_args
 # (2048x2048, 1024x4096) are rejected by Mosaic on this generation.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+# Per-pass backward tile defaults, used when the caller pins neither the
+# shared block_q/block_k nor the per-pass overrides.  None = inherit
+# DEFAULT_BLOCK_Q/K; the on-chip `tools/tpu_kernel_validate.py --bwd-sweep`
+# results get pinned HERE (VERDICT r3 next #3) so every backward call
+# site (ring hops, zigzag, single-sweep custom_vjp) picks them up.
+DEFAULT_BLOCK_Q_DKV: int | None = None
+DEFAULT_BLOCK_K_DKV: int | None = None
+DEFAULT_BLOCK_Q_DQ: int | None = None
+DEFAULT_BLOCK_K_DQ: int | None = None
 
 
 def _unify_vma(*arrays):
@@ -1142,6 +1151,15 @@ def pallas_flash_backward(
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
+    # per-call override > swept per-pass default > shared block_q/block_k
+    if block_q_dkv is None and block_q is None:
+        block_q_dkv = DEFAULT_BLOCK_Q_DKV
+    if block_k_dkv is None and block_k is None:
+        block_k_dkv = DEFAULT_BLOCK_K_DKV
+    if block_q_dq is None and block_q is None:
+        block_q_dq = DEFAULT_BLOCK_Q_DQ
+    if block_k_dq is None and block_k is None:
+        block_k_dq = DEFAULT_BLOCK_K_DQ
     bq1, bk1 = _block_sizes(
         nq, nk,
         block_q_dkv if block_q_dkv is not None else block_q,
