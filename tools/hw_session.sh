@@ -56,7 +56,7 @@ run() {  # run <tag> <budget_s> <cmd...>
 
 # --- round-4 pending measurements (VERDICT r3 next #1-#6) ---------------
 # 1. re-baseline: parity + fwd/fwdbwd at the north star
-run validate 900  python tools/tpu_kernel_validate.py --sweep --seq 262144
+run validate 1200 python tools/tpu_kernel_validate.py --sweep --seq 262144
 # 2. hop-sequence at 262k — needs the 900s+ compile budget (4 kernel
 #    programs in one jit); r2 done-criterion at the north-star length
 run hops262k 1800 python bench.py --worker pallas 262144 hops '{"ring": 4}'
