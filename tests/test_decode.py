@@ -237,6 +237,35 @@ def test_generate_256_on_ring(rng):
     assert traces == 1
 
 
+@pytest.mark.parametrize("cfg", [
+    # (prompt_len, steps, temperature, top_k, top_p)
+    (3, 7, 0.0, None, None),
+    (9, 5, 1.3, 3, None),
+    (5, 11, 0.6, None, 0.7),
+    (1, 4, 2.0, 7, 0.99),
+])
+def test_fuzz_generate_configs(rng, cfg):
+    """Generate across odd prompt/step/sampling combos: shape, range and
+    fixed-rng determinism hold for every knob combination."""
+    n, steps, temp, tk, tp = cfg
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=1, heads=2, dim_head=16,
+        causal=True, bucket_size=8, use_ring=False,
+    )
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (2, n)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    kw = dict(method=RingTransformer.generate, temperature=temp,
+              top_k=tk, top_p=tp)
+    if temp > 0:
+        kw["rng"] = jax.random.PRNGKey(11)
+    out = model.apply(params, prompt, 32, steps, **kw)
+    assert out.shape == (2, steps)
+    assert ((out >= 0) & (out < VOCAB)).all()
+    np.testing.assert_array_equal(
+        out, model.apply(params, prompt, 32, steps, **kw)
+    )
+
+
 def test_decode_with_lookback(rng):
     """Layers with lookback windows must decode identically to the forward
     (regression: decode_step ignoring max_lookback_seq_len)."""
