@@ -51,7 +51,14 @@ run() {  # run <tag> <budget_s> <cmd...>
          "wedged — probe health before running anything else" >&2
     exit 124
   fi
-  if [ "$rc" -eq 0 ]; then echo "$tag" >> "$DONE"; fi
+  if [ "$rc" -eq 0 ]; then
+    echo "$tag" >> "$DONE"
+    # aggregate every JSON measurement line under its step tag so the
+    # whole session reads as one results file
+    grep '^{' "$LOGDIR/${tag}.log" | while IFS= read -r line; do
+      printf '{"step": "%s", "result": %s}\n' "$tag" "$line"
+    done >> "$LOGDIR/results.jsonl"
+  fi
 }
 
 # --- round-4 pending measurements (VERDICT r3 next #1-#6) ---------------
