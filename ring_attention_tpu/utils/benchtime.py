@@ -45,6 +45,9 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
         )
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # cache every executable over the time threshold regardless of size
+    # (the hop-sequence/train programs are exactly the large ones)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     return cache_dir
 
 
