@@ -305,15 +305,28 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
     mask = jnp.ones((1, seq_len), jnp.bool_)
 
     block_k = extra.get("block_k")
-    if block_k and impl != "pallas":
-        raise ValueError(f"decode block_k only applies to impl='pallas', "
-                         f"got impl={impl!r}")
+    if block_k and impl not in ("pallas", "pallas_q8"):
+        raise ValueError(f"decode block_k only applies to the pallas "
+                         f"impls, got impl={impl!r}")
     if impl == "pallas":
         from ring_attention_tpu.ops.pallas_flash import pallas_flash_decode
 
         def attend(q, k, v, mask):
             out, _ = pallas_flash_decode(
                 q, k, v, mask, block_k=int(block_k) if block_k else None
+            )
+            return out
+    elif impl == "pallas_q8":
+        # int8 cache: quantized OUTSIDE the timed loop (a live cache is
+        # written quantized at decode_step time, read many times)
+        from ring_attention_tpu.ops.pallas_flash import (
+            pallas_flash_decode_q8,
+            quantize_kv_cache,
+        )
+
+        def attend(q, kv, mask):
+            out, _ = pallas_flash_decode_q8(
+                q, kv, mask, block_k=int(block_k) if block_k else None
             )
             return out
     else:
@@ -323,20 +336,26 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
             return default_attention(q, k, v, mask)
 
     iters = 50
+    if impl == "pallas_q8":
+        cache = (jax.jit(quantize_kv_cache)(k, v),)
+        # int8 rows + f32 per-token scales actually read per step
+        kv_bytes = 2 * kv_heads * seq_len * (DIM_HEAD + 4)
+    else:
+        cache = (k, v)
+        kv_bytes = 2 * kv_heads * seq_len * DIM_HEAD * 2  # k+v, bf16
 
-    # k/v/mask as arguments, never closures: a jit-captured 537 MB cache
+    # cache/mask as arguments, never closures: a jit-captured 537 MB cache
     # becomes an embedded constant (the relay's HTTP 413 failure mode)
     @jax.jit
-    def chained(q, k, v, mask):
+    def chained(q, cache, mask):
         def body(carry, _):
-            o = attend(carry, k, v, mask)
+            o = attend(carry, *cache, mask)
             return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
 
         out, ys = jax.lax.scan(body, q, None, length=iters)
         return ys.astype(jnp.float32).sum()
 
-    compile_s, secs = _timed(chained, (q, k, v, mask), iters)
-    kv_bytes = 2 * kv_heads * seq_len * DIM_HEAD * 2  # k+v, bf16
+    compile_s, secs = _timed(chained, (q, cache, mask), iters)
     print(
         json.dumps(
             {
@@ -345,8 +364,7 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
                 "decode_seq_len": seq_len,
                 "decode_impl": impl,
                 "decode_kv_heads": kv_heads,
-                **({"decode_block_k": int(block_k)}
-                   if impl == "pallas" and block_k else {}),
+                **({"decode_block_k": int(block_k)} if block_k else {}),
                 "decode_compile_s": round(compile_s, 1),
                 "device": getattr(dev, "device_kind", str(dev)),
             }

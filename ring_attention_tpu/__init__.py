@@ -19,6 +19,9 @@ from .ops import (
     flash_attention,
     pallas_flash_attention,
     pallas_flash_decode,
+    pallas_flash_decode_q8,
+    quantize_kv_cache,
+    QuantizedKV,
     ring_positions,
     rotary_freqs,
 )
@@ -56,6 +59,9 @@ __all__ = [
     "flash_attention",
     "pallas_flash_attention",
     "pallas_flash_decode",
+    "pallas_flash_decode_q8",
+    "quantize_kv_cache",
+    "QuantizedKV",
     "ring_flash_attention",
     "ring_positions",
     "rotary_freqs",

@@ -7,12 +7,21 @@ from .flash import (
     flash_backward_blocks,
     init_carry,
 )
-from .pallas_flash import pallas_flash_attention, pallas_flash_decode
+from .pallas_flash import (
+    QuantizedKV,
+    pallas_flash_attention,
+    pallas_flash_decode,
+    pallas_flash_decode_q8,
+    quantize_kv_cache,
+)
 from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
 
 __all__ = [
+    "QuantizedKV",
     "pallas_flash_attention",
     "pallas_flash_decode",
+    "pallas_flash_decode_q8",
+    "quantize_kv_cache",
     "default_attention",
     "softclamp",
     "MASK_VALUE",

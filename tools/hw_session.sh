@@ -72,6 +72,7 @@ run hops262k 1800 python bench.py --worker pallas 262144 hops '{"ring": 4}'
 #    deeper DMA pipelining may beat it)
 run decode_pallas 700 python bench.py --worker pallas 1048576 decode '{}'
 run decode_dense  700 python bench.py --worker dense  1048576 decode '{}'
+run decode_q8     700 python bench.py --worker pallas_q8 1048576 decode '{}'
 run decode_bk16k  500 python bench.py --worker pallas 1048576 decode '{"block_k": 16384}'
 run decode_bk32k  500 python bench.py --worker pallas 1048576 decode '{"block_k": 32768}'
 run decode_bk4k   500 python bench.py --worker pallas 1048576 decode '{"block_k": 4096}'
