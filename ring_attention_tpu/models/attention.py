@@ -30,7 +30,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import default_attention
 from ..ops.flash import flash_attention
-from ..ops.pallas_flash import pallas_flash_attention, pallas_flash_decode
+from ..ops.pallas_flash import (
+    QuantizedKV,
+    pallas_flash_attention,
+    pallas_flash_decode,
+    pallas_flash_decode_q8,
+    quantize_kv_cache,
+)
 from ..ops.rotary import apply_rotary, ring_positions, rotary_freqs
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.ring import ring_flash_attention
@@ -40,6 +46,14 @@ from ..parallel.ulysses import ulysses_attention
 from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
 from ..utils.validate import check_model_input
 from .layers import RMSNorm
+
+
+def _dequantize(kv: QuantizedKV, dtype) -> tuple[jax.Array, jax.Array]:
+    """Materialize the bf16/f32 KV a quantized cache represents (the
+    non-pallas decode fallback and test oracle)."""
+    k = kv.k_q.astype(jnp.float32) * kv.k_scale[..., None]
+    v = kv.v_q.astype(jnp.float32) * kv.v_scale[..., None]
+    return k.astype(dtype), v.astype(dtype)
 
 
 class RingAttention(nn.Module):
@@ -71,6 +85,13 @@ class RingAttention(nn.Module):
     # compiler/relay program-size limits at large heads x seq (see
     # ops/pallas_flash.py pallas_flash_attention)
     pallas_head_chunks: int | None = None
+    # store the decode KV cache as per-token-absmax int8 (+ f32 scales):
+    # 1.88x fewer cache HBM bytes per decode step at d=64 — the binding
+    # resource at long context — for ~1% output error (see
+    # ops/pallas_flash.py QuantizedKV).  Cache entries become
+    # (values int8, scales f32) tuples; decode attends via the q8 kernel
+    # (use_pallas) or a dequantized oracle fallback
+    quantize_cache: bool = False
     # context-parallel scheme over the seq mesh axis:
     #   "ring"    — KV rotation (+ striped load balance); the reference's core
     #   "zigzag"  — Llama-3 chunk pairing + all-gathered KV (causal only)
@@ -372,7 +393,23 @@ class RingAttention(nn.Module):
             k = apply_rotary(k, freqs)
 
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
-        if not ring:
+        if not ring and self.quantize_cache:
+            cache_k, cache_v = self._quantized_write(cache_k, cache_v, k, v, pos)
+            kv = QuantizedKV(*cache_k, *cache_v)
+            kv_mask = self._decode_mask(
+                jnp.arange(kv.k_q.shape[2]), pos, x.shape[0]
+            )
+            if self.use_pallas:
+                out, _ = pallas_flash_decode_q8(
+                    q, kv, kv_mask, softclamp_value=self.softclamp_value,
+                )
+            else:
+                k_deq, v_deq = _dequantize(kv, q.dtype)
+                out = default_attention(
+                    q, k_deq, v_deq, kv_mask,
+                    softclamp_value=self.softclamp_value,
+                )
+        elif not ring:
             cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), pos, axis=2)
             cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), pos, axis=2)
             kv_mask = self._decode_mask(
@@ -395,6 +432,23 @@ class RingAttention(nn.Module):
 
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], 1, -1)
         return self.to_out(out), cache_k, cache_v
+
+    @staticmethod
+    def _quantized_write(cache_k, cache_v, k, v, pos):
+        """Quantize this step's K/V rows and write values + scales at
+        ``pos``.  Cache entries are ``(values int8, scales f32)`` tuples."""
+        kq, ks, vq, vs = quantize_kv_cache(k, v)
+        (k_qc, k_sc), (v_qc, v_sc) = cache_k, cache_v
+
+        def wr(c, new, axis):
+            return lax.dynamic_update_slice_in_dim(
+                c, new.astype(c.dtype), pos, axis=axis
+            )
+
+        return (
+            (wr(k_qc, kq, 2), wr(k_sc, ks, 2)),
+            (wr(v_qc, vq, 2), wr(v_sc, vs, 2)),
+        )
 
     def _decode_mask(self, idx: jax.Array, pos: jax.Array, batch: int) -> jax.Array:
         """Valid-cache-slot mask for a decode step: ``[0, pos]``, windowed to
@@ -421,7 +475,8 @@ class RingAttention(nn.Module):
         ``(out (b,n,dim), cache_k, cache_v)``.
         """
         n = x.shape[1]
-        assert n <= cache_k.shape[2], "prompt longer than the cache"
+        max_len = (cache_k[0] if self.quantize_cache else cache_k).shape[2]
+        assert n <= max_len, "prompt longer than the cache"
         q, k, v = self._project_qkv(x)
         if self.rotary:
             freqs = rotary_freqs(jnp.arange(n), self.dim_head, self.rotary_theta)
@@ -437,9 +492,16 @@ class RingAttention(nn.Module):
                 window=self.max_lookback_seq_len,
                 softclamp_value=self.softclamp_value,
             )
-        zeros = (0, 0, 0, 0)
-        cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), zeros)
-        cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), zeros)
+        if self.quantize_cache:
+            # attention over the prompt ran on the exact K/V above; only
+            # the cache (what later decode steps read) is quantized
+            cache_k, cache_v = self._quantized_write(
+                cache_k, cache_v, k, v, 0
+            )
+        else:
+            zeros = (0, 0, 0, 0)
+            cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), zeros)
+            cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), zeros)
 
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], n, -1)
         return self.to_out(out), cache_k, cache_v
@@ -494,7 +556,8 @@ class RingAttention(nn.Module):
 
     def _ring_decode(self, q, k, v, cache_k, cache_v, pos):
         ring_size = self._ring_size()
-        n_local = cache_k.shape[2] // ring_size
+        quant = self.quantize_cache
+        n_local = (cache_k[0] if quant else cache_k).shape[2] // ring_size
 
         def core(q, k, v, cache_k, cache_v, pos):
             rank = lax.axis_index(SEQ_AXIS)
@@ -506,28 +569,61 @@ class RingAttention(nn.Module):
                     c, new.astype(c.dtype), local_pos, axis=2
                 )
 
-            cache_k = lax.cond(
-                rank == owner, lambda c: write(c, k), lambda c: c, cache_k
-            )
-            cache_v = lax.cond(
-                rank == owner, lambda c: write(c, v), lambda c: c, cache_v
-            )
+            if quant:
+                kq, ks, vq, vs = quantize_kv_cache(k, v)
+                cache_k = lax.cond(
+                    rank == owner,
+                    lambda c: (write(c[0], kq), write(c[1], ks)),
+                    lambda c: c, cache_k,
+                )
+                cache_v = lax.cond(
+                    rank == owner,
+                    lambda c: (write(c[0], vq), write(c[1], vs)),
+                    lambda c: c, cache_v,
+                )
+            else:
+                cache_k = lax.cond(
+                    rank == owner, lambda c: write(c, k), lambda c: c, cache_k
+                )
+                cache_v = lax.cond(
+                    rank == owner, lambda c: write(c, v), lambda c: c, cache_v
+                )
             idx = rank * n_local + jnp.arange(n_local)
             kv_mask = self._decode_mask(idx, pos, q.shape[0])
-            out = tree_attn_decode(
-                q, cache_k, cache_v, kv_mask,
-                axis_name=SEQ_AXIS,
-                softclamp_value=self.softclamp_value,
-                impl="pallas" if self.use_pallas else "xla",
-            )
+            if quant:
+                kvq = QuantizedKV(*cache_k, *cache_v)
+                if self.use_pallas:
+                    out = tree_attn_decode(
+                        q, None, None, kv_mask,
+                        axis_name=SEQ_AXIS,
+                        softclamp_value=self.softclamp_value,
+                        kv_quantized=kvq,
+                    )
+                else:
+                    k_deq, v_deq = _dequantize(kvq, q.dtype)
+                    out = tree_attn_decode(
+                        q, k_deq, v_deq, kv_mask,
+                        axis_name=SEQ_AXIS,
+                        softclamp_value=self.softclamp_value,
+                        impl="xla",
+                    )
+            else:
+                out = tree_attn_decode(
+                    q, cache_k, cache_v, kv_mask,
+                    axis_name=SEQ_AXIS,
+                    softclamp_value=self.softclamp_value,
+                    impl="pallas" if self.use_pallas else "xla",
+                )
             return out, cache_k, cache_v
 
         cspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        sspec = P(DATA_AXIS, None, SEQ_AXIS)
+        cache_spec = (cspec, sspec) if quant else cspec
         rep = P(DATA_AXIS, None, None, None)
         return jax.shard_map(
             core,
             mesh=self.mesh,
-            in_specs=(rep, rep, rep, cspec, cspec, P()),
-            out_specs=(rep, cspec, cspec),
+            in_specs=(rep, rep, rep, cache_spec, cache_spec, P()),
+            out_specs=(rep, cache_spec, cache_spec),
             check_vma=not self.use_pallas,
         )(q, k, v, cache_k, cache_v, pos)

@@ -59,6 +59,8 @@ class RingTransformer(nn.Module):
     use_pallas: bool = False
     # see RingAttention.pallas_head_chunks (program-size escape hatch)
     pallas_head_chunks: int | None = None
+    # see RingAttention.quantize_cache (int8 decode KV cache)
+    quantize_cache: bool = False
     sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
     ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
     ring_dkv_dtype: str | None = None  # see RingAttention.ring_dkv_dtype
@@ -112,6 +114,7 @@ class RingTransformer(nn.Module):
                 mesh=self.mesh,
                 use_pallas=self.use_pallas,
                 pallas_head_chunks=self.pallas_head_chunks,
+                quantize_cache=self.quantize_cache,
                 sequence_parallel=self.sequence_parallel,
                 ring_bidirectional=self.ring_bidirectional,
                 ring_dkv_dtype=self.ring_dkv_dtype,
@@ -228,19 +231,37 @@ class RingTransformer(nn.Module):
     # ------------------------------------------------------------------
 
     def init_cache(self, batch: int, max_len: int) -> dict[str, Any]:
-        """Fresh KV cache pytree; ``max_len`` must divide over the ring."""
+        """Fresh KV cache pytree; ``max_len`` must divide over the ring.
+
+        With ``quantize_cache`` each per-layer entry is a
+        ``(values int8, scales f32)`` tuple (see
+        ``RingAttention.quantize_cache``); otherwise a dense array in the
+        model dtype."""
         ring = self._ring_size()
         assert max_len % max(ring, 1) == 0
         kvh = self.kv_heads or self.heads
         shape = (batch, kvh, max_len, self.dim_head)
         dtype = self.dtype or jnp.float32
-        zeros = jnp.zeros(shape, dtype)
-        if ring > 1:
-            sharding = NamedSharding(self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
-            zeros = jax.device_put(zeros, sharding)
+        if self.quantize_cache:
+            entry = (
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:3], jnp.float32),
+            )
+            if ring > 1:
+                entry = (
+                    jax.device_put(entry[0], NamedSharding(
+                        self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None))),
+                    jax.device_put(entry[1], NamedSharding(
+                        self.mesh, P(DATA_AXIS, None, SEQ_AXIS))),
+                )
+        else:
+            entry = jnp.zeros(shape, dtype)
+            if ring > 1:
+                entry = jax.device_put(entry, NamedSharding(
+                    self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None)))
         return {
-            "k": [zeros for _ in range(self.depth)],
-            "v": [zeros for _ in range(self.depth)],
+            "k": [entry for _ in range(self.depth)],
+            "v": [entry for _ in range(self.depth)],
         }
 
     def decode_step(

@@ -237,6 +237,53 @@ def test_generate_256_on_ring(rng):
     assert traces == 1
 
 
+@pytest.mark.parametrize("use_ring,use_pallas", [
+    (False, False), (False, True), (True, False), (True, True),
+])
+def test_decode_quantized_cache(rng, use_ring, use_pallas):
+    """quantize_cache: int8 decode cache through prefill + decode_step
+    (local and ring-sharded) tracks the exact forward to quantization
+    tolerance, and generate() runs on the quantized-cache pytree."""
+    kw = dict(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, kv_heads=2, quantize_cache=True,
+        use_pallas=use_pallas,
+    )
+    model = RingTransformer(
+        **(dict(kw, mesh=create_mesh(ring_size=8)) if use_ring
+           else dict(kw, use_ring=False)),
+    )
+    ref_model = RingTransformer(
+        **{k: v for k, v in kw.items()
+           if k not in ("quantize_cache", "use_pallas")},
+        use_ring=False,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    full = ref_model.apply(params, tokens)
+
+    # prefill 8, decode 4 more: logits stay within quantization tolerance
+    cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
+    logits, cache = model.apply(
+        params, tokens[:, :8], cache, method=RingTransformer.prefill
+    )
+    np.testing.assert_allclose(logits, full[:, 7], atol=ATOL)  # exact path
+    for i in (8, 9, 10, 11):
+        logits, cache = model.apply(
+            params, tokens[:, i], cache, jnp.int32(i),
+            method=RingTransformer.decode_step,
+        )
+        rel = float(jnp.abs(logits - full[:, i]).max()
+                    / jnp.abs(full[:, i]).max())
+        assert rel < 0.05, (i, rel)
+
+    gen = model.apply(
+        params, tokens[:, :4], 16, 6, method=RingTransformer.generate
+    )
+    assert gen.shape == (2, 6)
+    assert ((gen >= 0) & (gen < VOCAB)).all()
+
+
 @pytest.mark.parametrize("cfg", [
     # (prompt_len, steps, temperature, top_k, top_p)
     (3, 7, 0.0, None, None),
