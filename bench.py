@@ -643,8 +643,8 @@ def main() -> None:
                 log.append(err)
 
     # phase 6 — million-token decode (BASELINE config 5): ms/token against
-    # a 2^20-token GQA cache, decode kernel vs the dense tile
-    for impl in ("pallas", "dense"):
+    # a 2^20-token GQA cache — decode kernel, int8-cache kernel, dense tile
+    for impl in ("pallas", "pallas_q8", "dense"):
         if not budget_left(600):
             log.append(f"decode:{impl}: skipped (budget)")
             continue
@@ -652,7 +652,7 @@ def main() -> None:
             impl, 1 << 20, "decode", min(600, deadline - time.monotonic())
         )
         if payload is not None:
-            suffix = "" if impl == "pallas" else "_dense"
+            suffix = {"pallas": "", "pallas_q8": "_q8", "dense": "_dense"}[impl]
             for key in ("decode_ms_per_token", "decode_kv_gbps"):
                 result[key + suffix] = payload[key]
             if impl == "pallas":
