@@ -393,12 +393,19 @@ class RingAttention(nn.Module):
             k = apply_rotary(k, freqs)
 
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
+        # the local cache is a ring buffer: writes land at pos % size and
+        # slot validity comes from _buffer_mask.  A full-length cache
+        # (size > every pos) reduces exactly to the plain layout, and a
+        # window-sized cache (size >= max_lookback_seq_len) stores only the
+        # window — O(W) decode memory/bandwidth instead of O(max_len) for
+        # lookback layers (see RingTransformer.windowed_cache)
         if not ring and self.quantize_cache:
-            cache_k, cache_v = self._quantized_write(cache_k, cache_v, k, v, pos)
-            kv = QuantizedKV(*cache_k, *cache_v)
-            kv_mask = self._decode_mask(
-                jnp.arange(kv.k_q.shape[2]), pos, x.shape[0]
+            size = cache_k[0].shape[2]
+            cache_k, cache_v = self._quantized_write(
+                cache_k, cache_v, k, v, pos % size
             )
+            kv = QuantizedKV(*cache_k, *cache_v)
+            kv_mask = self._buffer_mask(size, pos, x.shape[0])
             if self.use_pallas:
                 out, _ = pallas_flash_decode_q8(
                     q, kv, kv_mask, softclamp_value=self.softclamp_value,
@@ -410,11 +417,11 @@ class RingAttention(nn.Module):
                     softclamp_value=self.softclamp_value,
                 )
         elif not ring:
-            cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), pos, axis=2)
-            cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), pos, axis=2)
-            kv_mask = self._decode_mask(
-                jnp.arange(cache_k.shape[2]), pos, x.shape[0]
-            )
+            size = cache_k.shape[2]
+            slot = pos % size
+            cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), slot, axis=2)
+            cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), slot, axis=2)
+            kv_mask = self._buffer_mask(size, pos, x.shape[0])
             if self.use_pallas:
                 # single-sweep decode kernel: each cache byte read once per
                 # kv head, normalized output written in-kernel
@@ -452,11 +459,27 @@ class RingAttention(nn.Module):
 
     def _decode_mask(self, idx: jax.Array, pos: jax.Array, batch: int) -> jax.Array:
         """Valid-cache-slot mask for a decode step: ``[0, pos]``, windowed to
-        the last ``max_lookback_seq_len`` tokens when configured."""
+        the last ``max_lookback_seq_len`` tokens when configured.  ``idx``
+        are absolute token positions (the ring path's contiguous shards)."""
         keep = idx <= pos
         if self.max_lookback_seq_len is not None:
             keep = keep & (idx > pos - self.max_lookback_seq_len)
         return jnp.broadcast_to(keep[None, :], (batch, idx.shape[0]))
+
+    def _buffer_mask(self, size: int, pos: jax.Array, batch: int) -> jax.Array:
+        """Valid-slot mask for a ring-buffer cache of ``size`` slots.
+
+        Slot ``s`` holds the most recent position ``p_s <= pos`` with
+        ``p_s ≡ s (mod size)``; a slot is valid when that position exists
+        (``p_s >= 0``) and sits inside the lookback window.  With
+        ``size > pos`` this reduces to the plain ``idx <= pos`` mask, so
+        the local decode path uses it unconditionally."""
+        s = jnp.arange(size)
+        p = pos - ((pos - s) % size)
+        keep = p >= 0
+        if self.max_lookback_seq_len is not None:
+            keep = keep & (p > pos - self.max_lookback_seq_len)
+        return jnp.broadcast_to(keep[None, :], (batch, size))
 
     def prefill(
         self,
@@ -475,8 +498,20 @@ class RingAttention(nn.Module):
         ``(out (b,n,dim), cache_k, cache_v)``.
         """
         n = x.shape[1]
-        max_len = (cache_k[0] if self.quantize_cache else cache_k).shape[2]
-        assert n <= max_len, "prompt longer than the cache"
+        size = (cache_k[0] if self.quantize_cache else cache_k).shape[2]
+        if n > size:
+            # window-sized ring-buffer cache: only the last `size` rows
+            # survive (valid when the cache covers the lookback window —
+            # decode steps never look further back than that).  Not an
+            # assert: under python -O a silently-truncated global-attention
+            # cache would produce wrong logits with no error
+            if (self.max_lookback_seq_len is None
+                    or size < self.max_lookback_seq_len):
+                raise ValueError(
+                    f"prefill: prompt ({n}) longer than the cache ({size}) "
+                    f"is only valid for a window-sized cache covering "
+                    f"max_lookback_seq_len ({self.max_lookback_seq_len})"
+                )
         q, k, v = self._project_qkv(x)
         if self.rotary:
             freqs = rotary_freqs(jnp.arange(n), self.dim_head, self.rotary_theta)
@@ -492,16 +527,23 @@ class RingAttention(nn.Module):
                 window=self.max_lookback_seq_len,
                 softclamp_value=self.softclamp_value,
             )
+        if n > size:
+            # keep the last `size` rows, rolled into ring-buffer slot
+            # order: cache[s] = row at position p ≡ s (mod size)
+            k_rows = jnp.roll(k[:, :, n - size:], n % size, axis=2)
+            v_rows = jnp.roll(v[:, :, n - size:], n % size, axis=2)
+        else:
+            k_rows, v_rows = k, v  # slots [0, n) are the positions [0, n)
         if self.quantize_cache:
             # attention over the prompt ran on the exact K/V above; only
             # the cache (what later decode steps read) is quantized
             cache_k, cache_v = self._quantized_write(
-                cache_k, cache_v, k, v, 0
+                cache_k, cache_v, k_rows, v_rows, 0
             )
         else:
             zeros = (0, 0, 0, 0)
-            cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), zeros)
-            cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), zeros)
+            cache_k = lax.dynamic_update_slice(cache_k, k_rows.astype(cache_k.dtype), zeros)
+            cache_v = lax.dynamic_update_slice(cache_v, v_rows.astype(cache_v.dtype), zeros)
 
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], n, -1)
         return self.to_out(out), cache_k, cache_v

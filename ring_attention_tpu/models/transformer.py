@@ -61,6 +61,13 @@ class RingTransformer(nn.Module):
     pallas_head_chunks: int | None = None
     # see RingAttention.quantize_cache (int8 decode KV cache)
     quantize_cache: bool = False
+    # size each layer's decode cache to its lookback window instead of
+    # max_len (local decode only): a layer with max_lookback_seq_len=W
+    # stores and reads O(W) cache rows per step regardless of context
+    # length — the decode-side payoff of the local->global layer ladder.
+    # The cache is a ring buffer (writes at pos % size); exactness is
+    # untouched because those layers never attend past their window
+    windowed_cache: bool = False
     sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
     ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
     ring_dkv_dtype: str | None = None  # see RingAttention.ring_dkv_dtype
@@ -239,29 +246,43 @@ class RingTransformer(nn.Module):
         model dtype."""
         ring = self._ring_size()
         assert max_len % max(ring, 1) == 0
-        kvh = self.kv_heads or self.heads
-        shape = (batch, kvh, max_len, self.dim_head)
-        dtype = self.dtype or jnp.float32
-        if self.quantize_cache:
-            entry = (
-                jnp.zeros(shape, jnp.int8),
-                jnp.zeros(shape[:3], jnp.float32),
+        if self.windowed_cache:
+            assert ring <= 1, (
+                "windowed_cache is a local-decode optimization; the "
+                "ring-sharded cache uses absolute positions"
             )
-            if ring > 1:
+        kvh = self.kv_heads or self.heads
+        dtype = self.dtype or jnp.float32
+
+        def make_entry(size):
+            shape = (batch, kvh, size, self.dim_head)
+            if self.quantize_cache:
                 entry = (
-                    jax.device_put(entry[0], NamedSharding(
-                        self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None))),
-                    jax.device_put(entry[1], NamedSharding(
-                        self.mesh, P(DATA_AXIS, None, SEQ_AXIS))),
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:3], jnp.float32),
                 )
-        else:
+                if ring > 1:
+                    entry = (
+                        jax.device_put(entry[0], NamedSharding(
+                            self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None))),
+                        jax.device_put(entry[1], NamedSharding(
+                            self.mesh, P(DATA_AXIS, None, SEQ_AXIS))),
+                    )
+                return entry
             entry = jnp.zeros(shape, dtype)
             if ring > 1:
                 entry = jax.device_put(entry, NamedSharding(
                     self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None)))
+            return entry
+
+        sizes = [
+            min(max_len, lb) if self.windowed_cache and lb is not None
+            else max_len
+            for lb in self._lookbacks()
+        ]
         return {
-            "k": [entry for _ in range(self.depth)],
-            "v": [entry for _ in range(self.depth)],
+            "k": [make_entry(s) for s in sizes],
+            "v": [make_entry(s) for s in sizes],
         }
 
     def decode_step(

@@ -237,6 +237,86 @@ def test_generate_256_on_ring(rng):
     assert traces == 1
 
 
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_windowed_cache_decode(rng, use_pallas):
+    """windowed_cache: a lookback layer's ring-buffer cache (W slots
+    instead of max_len) decodes identically to the full-length cache —
+    per-layer sizes, mixed windowed/global depth."""
+    kw = dict(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+        max_lookback_seq_len=(4, None), use_pallas=use_pallas,
+    )
+    model = RingTransformer(windowed_cache=True, **kw)
+    ref_model = RingTransformer(**kw)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    full = ref_model.apply(params, tokens)
+
+    cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
+    assert cache["k"][0].shape[2] == 4 and cache["k"][1].shape[2] == 16
+    _, step = _jit_decode_fns(model)
+    for i in range(12):
+        logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
+        np.testing.assert_allclose(logits, full[:, i], atol=ATOL, err_msg=i)
+
+
+def test_windowed_cache_prefill_long_prompt(rng):
+    """A prompt longer than the window-sized cache prefills the last W
+    rows in ring-buffer order; decode continues exactly."""
+    kw = dict(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False,
+        max_lookback_seq_len=4,
+    )
+    model = RingTransformer(windowed_cache=True, **kw)
+    ref_model = RingTransformer(**kw)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    params = ref_model.init(jax.random.PRNGKey(0), tokens)
+    full = ref_model.apply(params, tokens)
+
+    cache = model.apply(params, 2, 16, method=RingTransformer.init_cache)
+    assert cache["k"][0].shape[2] == 4  # window-sized: prompt 10 > 4
+    logits, cache = model.apply(
+        params, tokens[:, :10], cache, method=RingTransformer.prefill
+    )
+    np.testing.assert_allclose(logits, full[:, 9], atol=ATOL)
+    _, step = _jit_decode_fns(model)
+    for i in (10, 11):
+        logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
+        np.testing.assert_allclose(logits, full[:, i], atol=ATOL, err_msg=i)
+
+    # windowed + quantized combination: quantization is deterministic, so
+    # the windowed int8 cache must match the full-length int8 cache to
+    # reduction-order tolerance (the ring buffer rotates slot order, so
+    # the softmax sums reassociate at ulp level) — catches mis-rolled
+    # rows/scales, not just shape bugs
+    qwin = RingTransformer(windowed_cache=True, quantize_cache=True, **kw)
+    qfull = RingTransformer(quantize_cache=True, **kw)
+    cw = qwin.apply(params, 2, 16, method=RingTransformer.init_cache)
+    cf = qfull.apply(params, 2, 16, method=RingTransformer.init_cache)
+    lw, cw = qwin.apply(params, tokens[:, :10], cw,
+                        method=RingTransformer.prefill)
+    lf, cf = qfull.apply(params, tokens[:, :10], cf,
+                         method=RingTransformer.prefill)
+    np.testing.assert_allclose(lw, lf, atol=1e-4)
+    for i in (10, 11):
+        lw, cw = qwin.apply(params, tokens[:, i], cw, jnp.int32(i),
+                            method=RingTransformer.decode_step)
+        lf, cf = qfull.apply(params, tokens[:, i], cf, jnp.int32(i),
+                             method=RingTransformer.decode_step)
+        np.testing.assert_allclose(lw, lf, atol=1e-4)
+
+    # over-long prompt on an unwindowed cache must hard-error, not truncate
+    plain = RingTransformer(**kw)
+    with pytest.raises(ValueError, match="window-sized"):
+        bad = RingTransformer(
+            **{**kw, "max_lookback_seq_len": None}, windowed_cache=True
+        )
+        c = bad.apply(params, 2, 8, method=RingTransformer.init_cache)
+        bad.apply(params, tokens, c, method=RingTransformer.prefill)
+
+
 @pytest.mark.parametrize("use_ring,use_pallas", [
     (False, False), (False, True), (True, False), (True, True),
 ])
