@@ -61,18 +61,21 @@ run() {  # run <tag> <budget_s> <cmd...>
   fi
 }
 
-# --- round-4 pending measurements (VERDICT r3 next #1-#6) ---------------
+# --- round-4 pending measurements (VERDICT r3 next #1-#6), ordered so a
+# SHORT healthy window still cashes the never-measured kernels (cheap
+# compiles) before the expensive multi-program compiles -----------------
 # 1. re-baseline: parity + fwd/fwdbwd at the north star
 run validate 1200 python tools/tpu_kernel_validate.py --sweep --seq 262144
-# 2. hop-sequence at 262k — needs the 900s+ compile budget (4 kernel
+# 2. decode kernels' FIRST real Mosaic runs: the bf16 decode kernel, the
+#    int8-cache kernel (pre-registered prediction ~0.56 ms/token,
+#    docs/hardware_log.md), and the dense comparison point
+run decode_pallas 700 python bench.py --worker pallas 1048576 decode '{}'
+run decode_q8     700 python bench.py --worker pallas_q8 1048576 decode '{}'
+run decode_dense  700 python bench.py --worker dense  1048576 decode '{}'
+# 3. hop-sequence at 262k — needs the 900s+ compile budget (4 kernel
 #    programs in one jit); r2 done-criterion at the north-star length
 run hops262k 1800 python bench.py --worker pallas 262144 hops '{"ring": 4}'
-# 3. decode kernel's FIRST real Mosaic run (+ dense comparison point);
-#    then a small block_k sweep around the 8192 default (bandwidth-bound:
-#    deeper DMA pipelining may beat it)
-run decode_pallas 700 python bench.py --worker pallas 1048576 decode '{}'
-run decode_dense  700 python bench.py --worker dense  1048576 decode '{}'
-run decode_q8     700 python bench.py --worker pallas_q8 1048576 decode '{}'
+# 3b. decode block_k sweep around the 8192 default
 run decode_bk16k  500 python bench.py --worker pallas 1048576 decode '{"block_k": 16384}'
 run decode_bk32k  500 python bench.py --worker pallas 1048576 decode '{"block_k": 32768}'
 run decode_bk4k   500 python bench.py --worker pallas 1048576 decode '{"block_k": 4096}'
