@@ -81,8 +81,32 @@ def main() -> None:
     loss = float(multihost_utils.process_allgather(loss, tiled=True))
     gnorm = float(multihost_utils.process_allgather(gnorm, tiled=True))
     assert np.isfinite(loss) and np.isfinite(gnorm)
-    print(f"MULTIHOST-OK {pid} loss={loss:.4f} gnorm={gnorm:.4f}",
-          flush=True)
+
+    # decode across the process boundary: a (data=1, seq=8) mesh puts the
+    # KV-cache shards of ONE ring on both processes, so the tree-decode
+    # collectives (pmax + 2 psum) cross the gloo transport for real — the
+    # cross-host decode path a multi-host pod serves
+    dmesh = create_mesh(ring_size=8)
+    dmodel = RingTransformer(
+        num_tokens=256, dim=32, depth=1, heads=4, dim_head=8,
+        kv_heads=2, causal=True, bucket_size=8, mesh=dmesh,
+    )
+    prompt = jnp.asarray(full[:1, :8], jnp.int32)  # same on both processes
+    dparams = dmodel.init(jax.random.PRNGKey(0), prompt)
+    toks = jax.jit(lambda p, t: dmodel.apply(
+        p, t, 16, 3, method=RingTransformer.generate))(dparams, prompt)
+    # the output is replicated: tiled=True fetches the global value to the
+    # host; re-gathering that HOST value stacks one copy per process, so
+    # the equality check proves both processes decoded identical tokens
+    local_toks = np.asarray(multihost_utils.process_allgather(toks, tiled=True))
+    per_proc = np.asarray(
+        multihost_utils.process_allgather(local_toks)
+    ).reshape(nproc, -1)
+    assert (per_proc[0] == per_proc[1]).all(), per_proc
+    dec = ",".join(str(t) for t in per_proc[0])
+
+    print(f"MULTIHOST-OK {pid} loss={loss:.4f} gnorm={gnorm:.4f} "
+          f"decode={dec}", flush=True)
 
 
 if __name__ == "__main__":
