@@ -29,6 +29,7 @@ from .parallel import (
     all_gather_variable,
     axis_rank,
     axis_world,
+    compact_masked,
     create_mesh,
     ring_flash_attention,
     stripe_permute,
@@ -47,6 +48,7 @@ __all__ = [
     "all_gather_variable",
     "axis_rank",
     "axis_world",
+    "compact_masked",
     "restore_checkpoint",
     "save_checkpoint",
     "trace",

@@ -32,6 +32,7 @@ from ..ops.attention import default_attention
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import (
     QuantizedKV,
+    dequantize_kv_cache as _dequantize,
     pallas_flash_attention,
     pallas_flash_decode,
     pallas_flash_decode_q8,
@@ -46,14 +47,6 @@ from ..parallel.ulysses import ulysses_attention
 from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
 from ..utils.validate import check_model_input
 from .layers import RMSNorm
-
-
-def _dequantize(kv: QuantizedKV, dtype) -> tuple[jax.Array, jax.Array]:
-    """Materialize the bf16/f32 KV a quantized cache represents (the
-    non-pallas decode fallback and test oracle)."""
-    k = kv.k_q.astype(jnp.float32) * kv.k_scale[..., None]
-    v = kv.v_q.astype(jnp.float32) * kv.v_scale[..., None]
-    return k.astype(dtype), v.astype(dtype)
 
 
 class RingAttention(nn.Module):
@@ -634,21 +627,14 @@ class RingAttention(nn.Module):
             kv_mask = self._decode_mask(idx, pos, q.shape[0])
             if quant:
                 kvq = QuantizedKV(*cache_k, *cache_v)
-                if self.use_pallas:
-                    out = tree_attn_decode(
-                        q, None, None, kv_mask,
-                        axis_name=SEQ_AXIS,
-                        softclamp_value=self.softclamp_value,
-                        kv_quantized=kvq,
-                    )
-                else:
-                    k_deq, v_deq = _dequantize(kvq, q.dtype)
-                    out = tree_attn_decode(
-                        q, k_deq, v_deq, kv_mask,
-                        axis_name=SEQ_AXIS,
-                        softclamp_value=self.softclamp_value,
-                        impl="xla",
-                    )
+                # impl="xla" dequantizes inside tree_attn_decode
+                out = tree_attn_decode(
+                    q, None, None, kv_mask,
+                    axis_name=SEQ_AXIS,
+                    softclamp_value=self.softclamp_value,
+                    impl=None if self.use_pallas else "xla",
+                    kv_quantized=kvq,
+                )
             else:
                 out = tree_attn_decode(
                     q, cache_k, cache_v, kv_mask,

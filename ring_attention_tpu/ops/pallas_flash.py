@@ -32,6 +32,7 @@ group-summed outside (ref ``ring_flash_attention.py:370-371``).
 from __future__ import annotations
 
 import functools
+import math
 import warnings
 from typing import NamedTuple
 
@@ -504,7 +505,8 @@ def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l, row0, col0,
     s = lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    s = s * scale
+    if scale != 1.0:  # static: folded into q for power-of-two scales
+        s = s * scale
     if softclamp_value is not None:
         s = jnp.tanh(s / softclamp_value) * softclamp_value
 
@@ -545,6 +547,17 @@ def _flash_fwd_call(
     g = h // hk
     bq, bk = _block_sizes(nq, nk, block_q, block_k)
     interpret = _interpret_default() if interpret is None else interpret
+
+    # power-of-two scale (every d = 4^k head dim, incl. the headline d=64
+    # -> 1/8) folds into q exactly (exponent shift, bit-identical scores)
+    # BEFORE the launch, deleting the per-tile (bq, bk) VPU multiply from
+    # the score path — the roofline puts fwd within ~30% of VPU-bound
+    # (docs/hardware_log.md, round-5 roofline note), so score-path VPU ops
+    # are the scarce resource.  Non-power-of-two scales keep the in-kernel
+    # multiply: folding those would round q a second time.
+    if scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
+        q = q * jnp.asarray(scale, q.dtype)
+        scale = 1.0
 
     causal = causal_offset is not None
     windowed = window_lo is not None and causal
@@ -899,6 +912,16 @@ def quantize_kv_cache(k: jax.Array, v: jax.Array) -> QuantizedKV:
     k_q, k_scale = one(k)
     v_q, v_scale = one(v)
     return QuantizedKV(k_q, k_scale, v_q, v_scale)
+
+
+def dequantize_kv_cache(
+    kv: QuantizedKV, dtype=jnp.bfloat16
+) -> tuple[jax.Array, jax.Array]:
+    """Materialize the KV a quantized cache represents (the non-pallas
+    decode fallback and the parity-test oracle)."""
+    k = kv.k_q.astype(jnp.float32) * kv.k_scale[..., None]
+    v = kv.v_q.astype(jnp.float32) * kv.v_scale[..., None]
+    return k.astype(dtype), v.astype(dtype)
 
 
 def _decode_q8_kernel(q_ref, kq_ref, ks_ref, vq_ref, vs_ref, *rest,

@@ -2,6 +2,7 @@ from .collectives import (
     all_gather_variable,
     axis_rank,
     axis_world,
+    compact_masked,
     fold_batch_into_seq,
     gather_sizes,
     split_by_rank,
@@ -36,6 +37,7 @@ __all__ = [
     "all_gather_variable",
     "axis_rank",
     "axis_world",
+    "compact_masked",
     "fold_batch_into_seq",
     "gather_sizes",
     "split_by_rank",

@@ -83,6 +83,27 @@ def all_gather_variable(
     return gathered, mask
 
 
+def compact_masked(gathered: jax.Array, mask: jax.Array, *, axis: int = 0) -> jax.Array:
+    """Drop the padding slots from an :func:`all_gather_variable` result.
+
+    Returns the dense rank-order concatenation the reference's
+    ``all_gather_variable_dim`` produces directly (ref
+    ``distributed.py:77-83``).  The output length is data-dependent, so
+    this runs on the host (outside ``jit``) — inside a compiled program,
+    keep the static ``(gathered, mask)`` pair and mask at the use site.
+    """
+    import numpy as np
+
+    g = np.asarray(gathered)
+    m = np.asarray(mask).astype(bool)
+    if m.shape != (g.shape[axis],):
+        raise ValueError(
+            f"mask shape {m.shape} must be ({g.shape[axis]},) — the flat "
+            f"validity mask returned by all_gather_variable for axis {axis}"
+        )
+    return jnp.asarray(np.take(g, np.nonzero(m)[0], axis=axis))
+
+
 def split_by_rank(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
     """Take this rank's equal slice of a replicated array
     (ref ``distributed.py:117-127``)."""

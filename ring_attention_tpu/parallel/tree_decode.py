@@ -21,6 +21,7 @@ from ..ops.attention import EPSILON
 from ..ops.flash import attend_blocks, init_carry, _ungroup
 from ..ops.pallas_flash import (
     QuantizedKV,
+    dequantize_kv_cache,
     pallas_flash_decode,
     pallas_flash_decode_q8,
 )
@@ -37,7 +38,7 @@ def tree_attn_decode(
     bucket_size: int | None = None,
     softclamp_value: float | None = None,
     scale: float | None = None,
-    impl: str = "xla",
+    impl: str | None = None,
     kv_quantized: QuantizedKV | None = None,
 ) -> jax.Array:
     """Single(-few)-token decode attention; call inside ``shard_map``.
@@ -54,12 +55,15 @@ def tree_attn_decode(
         ``"pallas"`` = :func:`~ring_attention_tpu.ops.pallas_flash.pallas_flash_decode`,
         which reads each cache byte exactly once per kv head (decode is
         HBM-bandwidth-bound; the training kernels re-fetch KV per query
-        head under GQA).
+        head under GQA).  ``None`` (default) = ``"xla"`` for a plain
+        cache, the q8 pallas kernel when ``kv_quantized`` is given.
       kv_quantized: int8 local cache shard
         (:func:`~ring_attention_tpu.ops.pallas_flash.quantize_kv_cache`);
         when given, ``k``/``v`` must be None and the local partial runs
         :func:`~ring_attention_tpu.ops.pallas_flash.pallas_flash_decode_q8`
-        (1.88x fewer cache HBM bytes per step).
+        (1.88x fewer cache HBM bytes per step).  An explicit
+        ``impl="xla"`` is honored by dequantizing the cache and running
+        the jnp sweep instead.
 
     Returns:
       ``(b, h, nq, d)`` decoded output, replicated across ``axis_name``.
@@ -67,6 +71,9 @@ def tree_attn_decode(
     b, h, nq, d = q.shape
     if scale is None:
         scale = d**-0.5
+
+    if impl not in (None, "xla", "pallas"):
+        raise ValueError(f"tree_attn_decode: unknown impl {impl!r}")
 
     # local online-softmax partial over the KV shard
     if kv_quantized is not None:
@@ -94,6 +101,13 @@ def tree_attn_decode(
                 f"tree_attn_decode: kv_mask must be (batch, seq_local) = "
                 f"{(kq.shape[0], kq.shape[2])}, got {kv_mask.shape}"
             )
+        if impl == "xla":
+            # honor the explicit XLA request: materialize the KV and fall
+            # through to the jnp sweep instead of silently running pallas
+            k, v = dequantize_kv_cache(kv_quantized, q.dtype)
+            kv_quantized = None
+
+    if kv_quantized is not None:
         acc, m, l = pallas_flash_decode_q8(
             q, kv_quantized, kv_mask,
             scale=scale, softclamp_value=softclamp_value,

@@ -18,6 +18,7 @@ from jax.sharding import PartitionSpec as P
 from ring_attention_tpu.parallel import create_mesh
 from ring_attention_tpu.parallel.collectives import (
     all_gather_variable,
+    compact_masked,
     fold_batch_into_seq,
     gather_sizes,
     split_by_rank,
@@ -57,6 +58,36 @@ def test_all_gather_variable(rng, mesh):
         [np.arange(max_size) < (r + 1) for r in range(world)]
     )
     np.testing.assert_array_equal(np.asarray(m), expect_mask)
+
+
+def test_compact_masked(rng, mesh):
+    """compact_masked on a variable gather reproduces the reference's dense
+    concatenated result (ref ``distributed.py:77-83``): each rank's used
+    prefix, in rank order, with all padding dropped."""
+    max_size, world = 8, 8
+    data = jnp.asarray(rng.standard_normal((world * max_size, 4)), jnp.float32)
+    lengths_global = jnp.arange(1, world + 1, dtype=jnp.int32)
+
+    def core(x, lengths):
+        rank = jax.lax.axis_index("seq")
+        return all_gather_variable(x, lengths[rank], "seq", max_size=max_size)
+
+    g, m = shard_map(
+        core, mesh=mesh,
+        in_specs=(P("seq", None), P()),
+        out_specs=(P(None, None), P()),
+        check_vma=False,
+    )(data, lengths_global)
+
+    dense = compact_masked(g, m)
+    expect = np.concatenate(
+        [np.asarray(data)[r * max_size : r * max_size + r + 1] for r in range(world)]
+    )
+    assert dense.shape == (int(lengths_global.sum()), 4)
+    np.testing.assert_allclose(np.asarray(dense), expect)
+
+    with pytest.raises(ValueError, match="mask shape"):
+        compact_masked(g, m[:-1])
 
 
 def test_split_by_rank(rng, mesh):

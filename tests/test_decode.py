@@ -308,17 +308,22 @@ def test_windowed_cache_prefill_long_prompt(rng):
         np.testing.assert_allclose(lw, lf, atol=1e-4)
 
     # over-long prompt on an unwindowed cache must hard-error, not truncate
-    plain = RingTransformer(**kw)
+    bad = RingTransformer(
+        **{**kw, "max_lookback_seq_len": None}, windowed_cache=True
+    )
+    c = bad.apply(params, 2, 8, method=RingTransformer.init_cache)
     with pytest.raises(ValueError, match="window-sized"):
-        bad = RingTransformer(
-            **{**kw, "max_lookback_seq_len": None}, windowed_cache=True
-        )
-        c = bad.apply(params, 2, 8, method=RingTransformer.init_cache)
         bad.apply(params, tokens, c, method=RingTransformer.prefill)
 
 
 @pytest.mark.parametrize("use_ring,use_pallas", [
-    (False, False), (False, True), (True, False), (True, True),
+    # local variants stay in the fast tier so `-m "not slow"` still covers
+    # the model-level quantized dispatch for BOTH impl paths; the
+    # ring-sharded variants (~40 s each on 1 CPU) are the slow tier
+    (False, False),
+    (False, True),
+    pytest.param(True, False, marks=pytest.mark.slow),
+    pytest.param(True, True, marks=pytest.mark.slow),
 ])
 def test_decode_quantized_cache(rng, use_ring, use_pallas):
     """quantize_cache: int8 decode cache through prefill + decode_step

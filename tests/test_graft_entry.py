@@ -23,6 +23,7 @@ def test_entry_compiles():
     assert bool(jnp.isfinite(loss))
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__ as g
 
@@ -74,6 +75,7 @@ def bench_records():
     return dict(zip(("fwd", "fwdbwd", "train", "decode"), recs))
 
 
+@pytest.mark.slow
 def test_bench_worker_contract(bench_records):
     """bench.py --worker prints one parseable JSON measurement line, with
     compile time recorded separately from step time."""
@@ -81,6 +83,7 @@ def test_bench_worker_contract(bench_records):
     assert {"value", "vs_baseline", "seq_len", "impl", "compile_s"} <= set(rec)
 
 
+@pytest.mark.slow
 def test_bench_worker_fwdbwd(bench_records):
     """Backward-included attention timing (the other half of the
     north-star: BASELINE.md wants fwd AND training-relevant numbers)."""
@@ -88,6 +91,7 @@ def test_bench_worker_fwdbwd(bench_records):
     assert rec["value"] > 0 and rec["ms_per_step"] > 0
 
 
+@pytest.mark.slow
 def test_bench_worker_decode(bench_records):
     """Million-token-decode mode (here at 1024): ms/token + effective
     KV-read bandwidth via the decode kernel (interpret mode on CPU)."""
@@ -96,6 +100,7 @@ def test_bench_worker_decode(bench_records):
     assert rec["decode_impl"] == "pallas"
 
 
+@pytest.mark.slow
 def test_bench_worker_train(bench_records):
     """Train-step (fwd+bwd+adam) tokens/sec measurement."""
     rec = bench_records["train"]

@@ -205,6 +205,7 @@ def test_stripe_roundtrip(rng):
 
 
 @pytest.mark.parametrize("striped", [False, True])
+@pytest.mark.slow
 def test_ring_pallas_impl(rng, mesh, striped):
     """Ring attention with the Pallas per-hop kernels (interpret mode on CPU)
     matches the oracle, fwd and bwd."""
@@ -254,6 +255,7 @@ def test_ring_bf16(rng, mesh):
 
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.slow
 def test_ring_striped_window_exact(rng, mesh, impl):
     """Sliding windows under STRIPED layout are exact (the reference only
     approximates striped lookback at bucket granularity): per-hop band
@@ -407,6 +409,7 @@ def test_ring_bidirectional_striped_window(rng, mesh):
         np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_ring_bidirectional_pallas(rng, mesh):
     """Bidirectional streams through the Pallas per-hop kernels."""
     q, k, v = make_qkv(rng, hk=2)
@@ -443,6 +446,7 @@ def test_ring_determinism(rng, mesh):
 
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.slow
 def test_ring_dkv_bf16_circulation(rng, mesh, impl):
     """dkv_dtype="bfloat16" halves the backward ring's ICI bandwidth (the
     reference circulates half-precision dkv, ring_flash_attention_cuda.py:

@@ -222,6 +222,7 @@ def test_remat_save_attn_actually_elides(rng, mesh, use_mesh):
     assert dots_save <= dots_plain - 2 * m_plain.depth, (dots_save, dots_plain)
 
 
+@pytest.mark.slow
 def test_variable_per_rank_batch(rng):
     """Variable per-rank batch through the model path (the reference's
     ``batch_size_var_len``, assert_attn.py:81-82 via distributed.py:58-84):

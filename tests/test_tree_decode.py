@@ -128,6 +128,25 @@ def test_tree_decode_q8_cache(rng, mesh):
     with pytest.raises(ValueError):
         tree_attn_decode(q, k, v, axis_name="seq", kv_quantized=kv)
 
+    # an explicit impl="xla" with a quantized cache is honored: the cache
+    # dequantizes internally and the jnp sweep runs (no silent pallas)
+    out_xla = shard_map(
+        lambda q, m, kv: tree_attn_decode(
+            q, None, None, m, axis_name="seq", bucket_size=16,
+            kv_quantized=kv, impl="xla",
+        ),
+        mesh=mesh,
+        in_specs=(P("data"), P("data", "seq"),
+                  QuantizedKV(kspec, sspec, kspec, sspec)),
+        out_specs=P("data"),
+        check_vma=False,
+    )(q, mask, kv)
+    np.testing.assert_allclose(out_xla, ref_deq, atol=ATOL)
+
+    with pytest.raises(ValueError, match="unknown impl"):
+        tree_attn_decode(q, None, None, axis_name="seq",
+                         kv_quantized=kv, impl="triton")
+
 
 def test_tree_decode_pallas_padded_cache(rng, mesh):
     """Pallas impl handles the fully-masked-shard edge (l=0 partials on

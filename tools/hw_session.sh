@@ -56,7 +56,8 @@ run() {  # run <tag> <budget_s> <cmd...>
     # aggregate every JSON measurement line under its step tag so the
     # whole session reads as one results file
     grep '^{' "$LOGDIR/${tag}.log" | while IFS= read -r line; do
-      printf '{"step": "%s", "result": %s}\n' "$tag" "$line"
+      printf '{"step": "%s", "date": "%s", "result": %s}\n' \
+        "$tag" "$(date -u +%F)" "$line"
     done >> "$LOGDIR/results.jsonl"
   fi
 }

@@ -46,7 +46,17 @@ def _categorize(name: str) -> str:
 
 
 def summarize(trace_dir: str, tag: str, out: list[str]) -> None:
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E501 (the one xplane proto in this image)
+    # parsing is best-effort: the traces on disk are the scarce artifact
+    # (captured in a healthy TPU window); a missing/broken proto parser
+    # must not fail the step and burn a re-capture on the next window
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E501 (the one xplane proto in this image)
+    except Exception as e:  # ImportError or any TF-init failure
+        out.append(
+            f"[{tag}] xplane parser unavailable ({type(e).__name__}: {e}); "
+            f"traces saved under {trace_dir} — parse offline"
+        )
+        return
 
     paths = glob.glob(
         os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
