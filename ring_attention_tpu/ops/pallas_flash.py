@@ -1179,7 +1179,9 @@ def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     # sT: (bk, bq) = k . q^T (contract d on both)
     sT = lax.dot_general(
         kb, qb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    )
+    if scale != 1.0:  # static: folded into q for power-of-two scales
+        sT = sT * scale
     if softclamp_value is not None:
         sT = jnp.tanh(sT / softclamp_value) * softclamp_value
 
@@ -1204,7 +1206,8 @@ def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     dsT = pT * (dpT - jnp.swapaxes(delta_ref[0], 0, 1))
     if softclamp_value is not None:
         dsT = dsT * (1.0 - (sT / softclamp_value) ** 2)
-    dsT = dsT * scale
+    if scale != 1.0:  # folded q̃ makes dsT·q̃ carry the factor exactly
+        dsT = dsT * scale
     dk[:] = dk[:] + lax.dot_general(
         dsT.astype(qb.dtype), qb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -1294,7 +1297,9 @@ def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     kb = k_ref[0]
     s = lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    )
+    if scale != 1.0:  # static: folded into q for power-of-two scales
+        s = s * scale
     if softclamp_value is not None:
         s = jnp.tanh(s / softclamp_value) * softclamp_value
 
@@ -1314,7 +1319,8 @@ def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     ds = p * (dp - delta_ref[0])
     if softclamp_value is not None:
         ds = ds * (1.0 - (s / softclamp_value) ** 2)
-    ds = ds * scale
+    if scale != 1.0:  # folded q̃: dq is post-scaled once on the output
+        ds = ds * scale
     dq[:] = dq[:] + lax.dot_general(
         ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -1391,6 +1397,18 @@ def pallas_flash_backward(
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
+
+    # power-of-two scale folds into q here too (exact, see _flash_fwd_call):
+    # s/sT recompute unchanged, dk = dsT·q̃ absorbs the factor exactly
+    # (dk = scale·dsTᵀ·q = dsTᵀ·(scale·q)), and dq comes out unscaled —
+    # multiplied once on the (nq, d) output below instead of per (bq, bk)
+    # tile.  Deletes BOTH per-tile score-path multiplies from each pass.
+    dq_post_scale = 1.0
+    if scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
+        q = q * jnp.asarray(scale, q.dtype)
+        dq_post_scale = scale
+        scale = 1.0
+
     # per-call override > swept per-pass default > shared block_q/block_k
     if block_q_dkv is None and block_q is None:
         block_q_dkv = DEFAULT_BLOCK_Q_DKV
@@ -1616,6 +1634,8 @@ def pallas_flash_backward(
         interpret=interpret,
     )(*dq_scalars, *inputs)
 
+    if dq_post_scale != 1.0:
+        dq = dq * dq_post_scale  # f32 output, power-of-two: exact
     return dq.reshape(b, h, nq, d), dk, dv
 
 
