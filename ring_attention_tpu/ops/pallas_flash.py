@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 import warnings
 from typing import NamedTuple
 
@@ -97,6 +98,25 @@ def _interpret_default() -> bool:
         return jax.devices()[0].platform != "tpu"
     except Exception:
         return True
+
+
+# log2-space scoring (candidate VPU optimization, A/B flag): fold
+# scale*log2(e) into q so the per-tile softmax runs p = exp2(s2 - m2) with
+# NO per-element multiply — neither the scale multiply nor exp's internal
+# range-scaling one (exp lowers as exp2(x*log2e)).  p/l/acc are value-
+# identical (exp2(a*log2e - b*log2e) == exp(a - b)); only the running max
+# changes basis and converts back (m = m2*ln2) at the final write, a
+# (bq, 1) op per block.  Costs one extra rounding of q by a non-power-of-
+# two constant (~2^-24 f32 / ~2^-9 bf16 relative — the level of bf16
+# storage noise).  Default OFF until measured on silicon: the win is zero
+# if Mosaic dispatches exp at the same rate as exp2
+# (docs/hardware_log.md round-5 roofline note).
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
+
+def _exp2_default() -> bool:
+    return os.environ.get("RING_ATTN_EXP2", "0") == "1"
 
 
 def _block_sizes(nq: int, nk: int, block_q: int | None, block_k: int | None):
@@ -392,21 +412,27 @@ def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_write(fused, outs, acc, m, l):
+def _fwd_write(fused, outs, acc, m, l, exp2=False):
     """Final write: raw partials for ring merging, or the fused normalized
     output + lse when no merge follows (the reference's
     ``RETURN_NORMALIZED_OUTPUT``, ref ``triton_flash_attn.py:273-275``) —
     at seq 262144 the raw path round-trips a 512 MB f32 accumulator
-    through HBM that the fused path never materializes."""
+    through HBM that the fused path never materializes.
+
+    Under log2-space scoring the running max is in log2 units; it converts
+    back to natural units here — a (bq, 1) op per block — so the emitted
+    partials/lse contract is basis-independent (ring merging and XLA-path
+    interop see identical values either way)."""
     if fused:
         out_ref, lse_ref = outs
         l_safe = jnp.maximum(l[:], EPSILON)
         out_ref[0] = (acc[:] / l_safe).astype(out_ref.dtype)
-        lse_ref[0] = m[:] + jnp.log(l_safe)
+        m_nat = m[:] * LN2 if exp2 else m[:]
+        lse_ref[0] = m_nat + jnp.log(l_safe)
     else:
         acc_ref, m_ref, l_ref = outs
         acc_ref[0] = acc[:]
-        m_ref[0] = m[:]
+        m_ref[0] = m[:] * LN2 if exp2 else m[:]
         l_ref[0] = l[:]
 
 
@@ -460,7 +486,10 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
     def _init():
         if resume:
             acc[:] = carry_refs[0][0]
-            m[:] = carry_refs[1][0]
+            # carries cross hops in natural units (basis-independent
+            # contract, see _fwd_write); log2-space kernels convert on load
+            m[:] = (carry_refs[1][0] * LOG2E if tile_kw.get("exp2")
+                    else carry_refs[1][0])
             l[:] = carry_refs[2][0]
         else:
             acc[:] = jnp.zeros_like(acc)
@@ -477,18 +506,37 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
 
     @pl.when(last)
     def _write():
-        _fwd_write(fused, outs, acc, m, l)
+        _fwd_write(fused, outs, acc, m, l, exp2=tile_kw.get("exp2", False))
 
 
-def _online_update(s, v, acc, m, l):
+def _softclamp(s, clamp, exp2):
+    """Clamp a score tile in natural units: ``c * tanh(s_nat / c)``, with
+    ``s`` (and the result) in log2 units when ``exp2`` — the one clamp
+    basis transform shared by the fwd tile and both bwd recomputes."""
+    if exp2:
+        return jnp.tanh(s * (LN2 / clamp)) * (clamp * LOG2E)
+    return jnp.tanh(s / clamp) * clamp
+
+
+def _softclamp_grad_factor(s_clamped, clamp, exp2):
+    """tanh' = 1 - (clamped_natural / c)^2 from the post-clamp scores
+    (log2-basis under ``exp2``); multiplies ds in both bwd passes."""
+    s_nat = s_clamped * LN2 if exp2 else s_clamped
+    return 1.0 - (s_nat / clamp) ** 2
+
+
+def _online_update(s, v, acc, m, l, exp2=False):
     """One online-softmax accumulator step over a masked score tile ``s``
     against value rows ``v`` — THE shared tile math of every forward-shaped
     kernel in this module (``p`` is cast to ``v.dtype`` so bf16 callers run
-    the pv matmul in bf16 and f32 callers in f32)."""
+    the pv matmul in bf16 and f32 callers in f32).  With ``exp2`` the tile
+    is in log2 space (s and m both scaled by log2e), so ``p``/``alpha``/
+    ``l``/``acc`` come out value-identical with a cheaper exponential."""
+    ex = jnp.exp2 if exp2 else jnp.exp
     m_prev = m[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
+    p = ex(s - m_new)
+    alpha = ex(m_prev - m_new)
     l[:] = l[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
     pv = lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -499,7 +547,8 @@ def _online_update(s, v, acc, m, l):
 
 
 def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l, row0, col0,
-              *, scale, softclamp_value, causal, windowed, masked, bq, bk):
+              *, scale, softclamp_value, causal, windowed, masked, bq, bk,
+              exp2=False):
     q = q_ref[0]
     k = k_ref[0]
     s = lax.dot_general(
@@ -508,7 +557,7 @@ def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l, row0, col0,
     if scale != 1.0:  # static: folded into q for power-of-two scales
         s = s * scale
     if softclamp_value is not None:
-        s = jnp.tanh(s / softclamp_value) * softclamp_value
+        s = _softclamp(s, softclamp_value, exp2)
 
     keep = _tile_keep(
         offs_ref, row0, col0, (bq, bk), 0, causal, windowed,
@@ -517,7 +566,7 @@ def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l, row0, col0,
     if keep is not None:
         s = jnp.where(keep, s, MASK_VALUE)
 
-    _online_update(s, v_ref[0], acc, m, l)
+    _online_update(s, v_ref[0], acc, m, l, exp2=exp2)
 
 
 class FlashPartials(NamedTuple):
@@ -555,7 +604,13 @@ def _flash_fwd_call(
     # (docs/hardware_log.md, round-5 roofline note), so score-path VPU ops
     # are the scarce resource.  Non-power-of-two scales keep the in-kernel
     # multiply: folding those would round q a second time.
-    if scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
+    # RING_ATTN_EXP2=1 additionally moves the whole tile into log2 space
+    # (fold scale*log2e, exponentials become exp2) — see _exp2_default.
+    exp2 = _exp2_default()
+    if exp2:
+        q = q * jnp.asarray(scale * LOG2E, q.dtype)
+        scale = 1.0
+    elif scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
         q = q * jnp.asarray(scale, q.dtype)
         scale = 1.0
 
@@ -583,6 +638,7 @@ def _flash_fwd_call(
         masked=masked,
         bq=bq,
         bk=bk,
+        exp2=exp2,
     )
 
     if compact:
@@ -1173,7 +1229,7 @@ def _bwd_dkv_kernel(
 
 def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
               kvm_ref, dk, dv, row0, col0, *, scale, softclamp_value,
-              causal, windowed, masked, bq, bk):
+              causal, windowed, masked, bq, bk, exp2=False):
     kb = k_ref[0]
     qb = q_ref[0]
     # sT: (bk, bq) = k . q^T (contract d on both)
@@ -1183,9 +1239,10 @@ def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     if scale != 1.0:  # static: folded into q for power-of-two scales
         sT = sT * scale
     if softclamp_value is not None:
-        sT = jnp.tanh(sT / softclamp_value) * softclamp_value
+        sT = _softclamp(sT, softclamp_value, exp2)
 
-    pT = jnp.exp(sT - jnp.swapaxes(lse_ref[0], 0, 1))
+    ex = jnp.exp2 if exp2 else jnp.exp
+    pT = ex(sT - jnp.swapaxes(lse_ref[0], 0, 1))
     keep = _tile_keep(
         offs_ref, row0, col0, (bk, bq), 1, causal, windowed,
         kvm_ref if masked else None,
@@ -1205,7 +1262,7 @@ def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     )
     dsT = pT * (dpT - jnp.swapaxes(delta_ref[0], 0, 1))
     if softclamp_value is not None:
-        dsT = dsT * (1.0 - (sT / softclamp_value) ** 2)
+        dsT = dsT * _softclamp_grad_factor(sT, softclamp_value, exp2)
     if scale != 1.0:  # folded q̃ makes dsT·q̃ carry the factor exactly
         dsT = dsT * scale
     dk[:] = dk[:] + lax.dot_general(
@@ -1292,7 +1349,7 @@ def _bwd_dq_kernel(
 
 def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
              kvm_ref, dq, row0, col0, *, scale, softclamp_value, causal,
-             windowed, masked, bq, bk):
+             windowed, masked, bq, bk, exp2=False):
     qb = q_ref[0]
     kb = k_ref[0]
     s = lax.dot_general(
@@ -1301,9 +1358,9 @@ def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     if scale != 1.0:  # static: folded into q for power-of-two scales
         s = s * scale
     if softclamp_value is not None:
-        s = jnp.tanh(s / softclamp_value) * softclamp_value
+        s = _softclamp(s, softclamp_value, exp2)
 
-    p = jnp.exp(s - lse_ref[0])
+    p = (jnp.exp2 if exp2 else jnp.exp)(s - lse_ref[0])
     keep = _tile_keep(
         offs_ref, row0, col0, (bq, bk), 0, causal, windowed,
         kvm_ref if masked else None,
@@ -1318,7 +1375,7 @@ def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     )
     ds = p * (dp - delta_ref[0])
     if softclamp_value is not None:
-        ds = ds * (1.0 - (s / softclamp_value) ** 2)
+        ds = ds * _softclamp_grad_factor(s, softclamp_value, exp2)
     if scale != 1.0:  # folded q̃: dq is post-scaled once on the output
         ds = ds * scale
     dq[:] = dq[:] + lax.dot_general(
@@ -1403,8 +1460,20 @@ def pallas_flash_backward(
     # (dk = scale·dsTᵀ·q = dsTᵀ·(scale·q)), and dq comes out unscaled —
     # multiplied once on the (nq, d) output below instead of per (bq, bk)
     # tile.  Deletes BOTH per-tile score-path multiplies from each pass.
+    # In exp2 mode (RING_ATTN_EXP2=1) the fold is scale*log2e and lse
+    # converts to log2 units once out here, so the in-tile p recompute is
+    # a bare exp2; dk then carries a surplus log2e absorbed by a ln2
+    # multiply on its (nk, d) output.
+    exp2 = _exp2_default()
     dq_post_scale = 1.0
-    if scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
+    dkv_post_scale = 1.0
+    if exp2:
+        q = q * jnp.asarray(scale * LOG2E, q.dtype)
+        lse = lse * LOG2E
+        dq_post_scale = scale
+        dkv_post_scale = LN2
+        scale = 1.0
+    elif scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
         q = q * jnp.asarray(scale, q.dtype)
         dq_post_scale = scale
         scale = 1.0
@@ -1508,6 +1577,7 @@ def pallas_flash_backward(
         masked=masked,
         bq=bq1,
         bk=bk1,
+        exp2=exp2,
     )
     common2 = dict(common1, bq=bq2, bk=bk2)
 
@@ -1579,6 +1649,10 @@ def pallas_flash_backward(
     # GQA: sum per-query-head dk/dv over the group
     dk = dk_h.reshape(b, hk, g, nk, d).sum(axis=2)
     dv = dv_h.reshape(b, hk, g, nk, d).sum(axis=2)
+    if dkv_post_scale != 1.0:
+        # exp2 mode: dsT·q̃ carries a surplus log2e; ln2 restores it
+        # (one (nk, d) f32 multiply vs one per (bq, bk) tile)
+        dk = dk * dkv_post_scale
 
     # ---- dq pass: grid (bh, q blocks, k blocks), or compacted band ----
     if compact_dq:

@@ -597,3 +597,80 @@ def test_decode_kernel_row_padding(rng, dtype, atol):
     np.testing.assert_allclose(
         out.astype(jnp.float32), ref.astype(jnp.float32), atol=atol
     )
+
+
+def test_exp2_log2_space_parity(rng, monkeypatch):
+    """RING_ATTN_EXP2=1 (log2-space scoring, docs/hardware_log.md round-5
+    roofline note) is value-identical at the kernel boundary: fwd outputs
+    AND grads match the natural-basis oracle, including softclamp + mask
+    + GQA, and the emitted lse stays in natural units."""
+    monkeypatch.setenv("RING_ATTN_EXP2", "1")
+    q, k, v = make_qkv(rng, hk=2, n=128, d=32)
+    mask = jnp.broadcast_to(jnp.arange(128)[None, :] < 100, (2, 128))
+    ref = default_attention(q, k, v, mask, causal=True, softclamp_value=15.0)
+    out = pallas_flash_attention(
+        q, k, v, mask, causal=True, softclamp_value=15.0, interpret=True
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def loss_p(q, k, v):
+        return (pallas_flash_attention(
+            q, k, v, mask, causal=True, softclamp_value=15.0, interpret=True
+        ) ** 2).sum()
+
+    def loss_o(q, k, v):
+        return (default_attention(
+            q, k, v, mask, causal=True, softclamp_value=15.0
+        ) ** 2).sum()
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss_o, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gp, go):
+        np.testing.assert_allclose(a, b, atol=3e-5, err_msg=f"d{name}")
+
+    # partials keep the natural-units contract (ring merging / carry interop)
+    from ring_attention_tpu.ops.pallas_flash import pallas_flash_partials
+
+    monkeypatch.setenv("RING_ATTN_EXP2", "0")
+    nat = pallas_flash_partials(q, k, v, scale=32**-0.5, causal_offset=0,
+                                interpret=True)
+    monkeypatch.setenv("RING_ATTN_EXP2", "1")
+    l2 = pallas_flash_partials(q, k, v, scale=32**-0.5, causal_offset=0,
+                               interpret=True)
+    np.testing.assert_allclose(l2.m, nat.m, atol=2e-5)
+    np.testing.assert_allclose(l2.l, nat.l, atol=2e-5)
+    np.testing.assert_allclose(l2.acc, nat.acc, atol=2e-5)
+
+
+def test_exp2_carry_resume_parity(rng, monkeypatch):
+    """Ring-hop carry resume under RING_ATTN_EXP2=1: the carry crosses the
+    kernel boundary in natural units and converts on load (the subtlest
+    line of the log2-space feature), so a partials hop + fused carry hop
+    must equal the single full sweep — including when the two hops run in
+    DIFFERENT bases (one kernel natural, the next log2)."""
+    from ring_attention_tpu.ops.pallas_flash import (
+        pallas_flash_fused,
+        pallas_flash_partials,
+    )
+
+    q, k, v = make_qkv(rng, hk=2, n=128, d=32)
+    scale = 32**-0.5
+    ref = default_attention(q, k, v)
+
+    def two_hop(basis_hop0, basis_hop1):
+        monkeypatch.setenv("RING_ATTN_EXP2", basis_hop0)
+        carry = pallas_flash_partials(
+            q, k[:, :, :64], v[:, :, :64], scale=scale, interpret=True
+        )
+        monkeypatch.setenv("RING_ATTN_EXP2", basis_hop1)
+        out, lse = pallas_flash_fused(
+            q, k[:, :, 64:], v[:, :, 64:], scale=scale, carry=carry,
+            interpret=True,
+        )
+        return out, lse
+
+    out_nat, lse_nat = two_hop("0", "0")
+    for hops in (("1", "1"), ("0", "1"), ("1", "0")):
+        out, lse = two_hop(*hops)
+        np.testing.assert_allclose(out, ref, atol=2e-5, err_msg=hops)
+        np.testing.assert_allclose(lse, lse_nat, atol=2e-5, err_msg=hops)

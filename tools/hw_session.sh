@@ -85,6 +85,12 @@ run bwdsweep 1800 python tools/tpu_kernel_validate.py --bwd-sweep --seq 262144
 # 5. train headline, both remat variants (save_attn expected >30k tok/s)
 run train_save 1200 python bench.py --worker pallas 262144 train '{"remat_policy": "save_attn"}'
 run train_full 1200 python bench.py --worker pallas 262144 train '{}'
+# 5b. log2-space scoring A/B (RING_ATTN_EXP2=1, docs/hardware_log.md
+#     round-5 roofline note): candidate VPU win, zero if exp and exp2
+#     dispatch at the same rate.  Same shapes as the standing fwd/fwdbwd
+#     numbers so the delta reads directly.
+run fwd_exp2    900 env RING_ATTN_EXP2=1 python bench.py --worker pallas 262144 fwd '{}'
+run fwdbwd_exp2 1200 env RING_ATTN_EXP2=1 python bench.py --worker pallas 262144 fwdbwd '{}'
 # 6. BASELINE config-4 shapes: GQA 32/4 and d128 (131072 = known-good,
 #    262144 = the full shape via the head-split launch)
 run gqa32      900 python bench.py --worker pallas 131072 fwd '{"heads": 32, "kv_heads": 4}'
