@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Watch for a healthy TPU tunnel window and cash it in IMMEDIATELY.
+#
+# Wraps tools/tpu_health_loop.sh's probe cadence, but instead of only
+# logging, the FIRST healthy probe launches tools/hw_session.sh (the
+# queued round-5 measurements) right away — windows have opened and
+# closed between operator checks before, and the queue is worth hours.
+#
+# One-client discipline: the watcher stops probing the moment it decides
+# to launch (hw_session does its own per-step probes), and only one
+# watcher may run (lockfile).  Everything logs to /tmp/tpu_health.log
+# plus docs/hwlogs/ via hw_session itself.
+#
+# Usage:  nohup bash tools/tpu_window_watch.sh >/dev/null 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${1:-600}
+LOCK=/tmp/tpu_window_watch.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "another window watcher is running ($LOCK exists)" >&2
+  exit 1
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+
+while true; do
+  touch /tmp/tpu_probe.lock
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout -k 30 120 python -c "import jax; print(jax.devices()[0].device_kind)  # tpu-health-probe-inner" 2>/dev/null)
+  rc=$?
+  rm -f /tmp/tpu_probe.lock
+  if [ "$rc" -eq 0 ]; then
+    echo "$ts HEALTHY ${out##*$'\n'} -> launching hw_session" >> /tmp/tpu_health.log
+    bash tools/hw_session.sh >> /tmp/tpu_health.log 2>&1
+    src=$?
+    echo "$(date -u +%H:%M:%S) hw_session exited rc=$src" >> /tmp/tpu_health.log
+    # session done (or aborted on a re-wedge): resume watching so a later
+    # window can pick up the remaining steps (done.txt resume)
+    if [ "$src" -eq 0 ]; then
+      echo "$(date -u +%H:%M:%S) all steps done; watcher exiting" >> /tmp/tpu_health.log
+      exit 0
+    fi
+  else
+    echo "$ts WEDGED rc=$rc" >> /tmp/tpu_health.log
+  fi
+  sleep "$INTERVAL"
+done
