@@ -35,5 +35,8 @@ class FeedForward(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         x = RMSNorm(self.dim)(x)
         h = nn.Dense(self.dim * self.mult, use_bias=False, dtype=self.dtype)(x)
-        h = nn.gelu(h)
+        # exact (erf) gelu: the reference's nn.GELU() default
+        # (ref ring_attention.py:484); the tanh approximation would be the
+        # one avoidable numeric divergence in cross-framework parity
+        h = nn.gelu(h, approximate=False)
         return nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(h)
