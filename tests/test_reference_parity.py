@@ -231,7 +231,7 @@ def test_model_matches_reference_with_copied_weights(rng):
     )
 
     def t(a):  # flax (in, out) kernel -> torch (out, in) weight
-        return torch.from_numpy(np.asarray(a))
+        return torch.from_numpy(np.asarray(a).copy())
 
     p = params["params"]
     with torch.no_grad():
@@ -254,6 +254,50 @@ def test_model_matches_reference_with_copied_weights(rng):
     ours = np.asarray(ours_model.apply(params, tokens))
     np.testing.assert_allclose(ours, theirs, atol=5e-4)
 
-    theirs_loss = float(ref_model(torch.from_numpy(tokens_np), return_loss=True))
+    with torch.no_grad():
+        theirs_loss = float(ref_model(torch.from_numpy(tokens_np), return_loss=True))
     ours_loss = float(ours_model.apply(params, tokens, return_loss=True))
     assert abs(ours_loss - theirs_loss) < 1e-4, (ours_loss, theirs_loss)
+
+
+def test_gqa_softclamp_grads_match_reference(rng):
+    """dq/dk/dv parity vs the reference's hand-written ring-flash backward
+    under GQA + softclamp together (the two features whose backward terms
+    interact: group-summed dk/dv, ref ring_flash_attention.py:370-371, and
+    the tanh-clamp chain rule, :330-333) — with the head-pairing
+    permutation from test_gqa_matches_reference applied to q/dq."""
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops import flash_attention
+
+    h, hk, n = 4, 2, 32
+    g = h // hk
+    q, k, v = make_inputs(rng, h=h, hk=hk, n=n)
+    perm = np.asarray([(i % hk) * g + i // hk for i in range(h)])
+
+    tq = torch.from_numpy(q[:, perm].copy()).transpose(1, 2).requires_grad_(True)
+    tk = torch.from_numpy(k.copy()).transpose(1, 2).requires_grad_(True)
+    tv = torch.from_numpy(v.copy()).transpose(1, 2).requires_grad_(True)
+    out = ref_flash.ring_flash_attn(
+        tq, tk, tv, causal=True, bucket_size=16, ring_reduce_col=False,
+        softclamp_qk_sim=True, softclamp_value=5.0,
+    )
+    (out ** 2).sum().backward()
+
+    gq, gk, gv = jax.grad(
+        lambda q, k, v: (flash_attention(
+            q, k, v, causal=True, bucket_size=16, softclamp_value=5.0,
+        ) ** 2).sum(),
+        (0, 1, 2),
+    )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+    np.testing.assert_allclose(
+        np.asarray(gq)[:, perm], tq.grad.transpose(1, 2).numpy(),
+        atol=5e-4, err_msg="dq",
+    )
+    for ours, theirs, name in ((gk, tk.grad, "dk"), (gv, tv.grad, "dv")):
+        np.testing.assert_allclose(
+            np.asarray(ours), theirs.transpose(1, 2).numpy(),
+            atol=5e-4, err_msg=name,
+        )
