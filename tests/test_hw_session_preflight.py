@@ -1,0 +1,211 @@
+"""Preflight every queued hardware-session step at tiny shapes on CPU.
+
+TPU windows on this image are scarce (multi-round tunnel wedges,
+docs/hardware_log.md); a queued `tools/hw_session.sh` step that dies on a
+Python-level bug — an argument the worker no longer accepts, a broken env
+flag, a typo in the step line — burns window budget that may not come
+back.  This suite parses the session script and runs each distinct worker
+invocation verbatim except for the sequence length (shrunk to CPU scale),
+so every step is known-launchable before a window ever opens.
+"""
+
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TINY_SEQ = 512
+TINY_DECODE_SEQ = 1024
+
+
+def _session_commands():
+    """(tag, argv, env_extra) for each `run <tag> <budget> ...` bench/tool
+    step in tools/hw_session.sh."""
+    path = os.path.join(REPO, "tools", "hw_session.sh")
+    steps = []
+    for line in open(path):
+        m = re.match(r"run (\S+)\s+\d+\s+(.+)$", line.strip())
+        if not m:
+            continue
+        tag, rest = m.group(1), m.group(2)
+        parts = shlex.split(rest)
+        env_extra = {}
+        if parts[0] == "env":
+            parts = parts[1:]
+            while "=" in parts[0]:
+                k, v = parts[0].split("=", 1)
+                env_extra[k] = v
+                parts = parts[1:]
+        steps.append((tag, parts, env_extra))
+    return steps
+
+
+STEPS = _session_commands()
+
+
+def test_session_script_parses():
+    """The session must queue every measurement family the round plans:
+    validation, decode (incl. q8), ring hops, bwd sweep, train, exp2 A/B,
+    config-4 shapes, xprof."""
+    tags = {t for t, _, _ in STEPS}
+    for expected in ("validate", "decode_q8", "hops262k", "bwdsweep",
+                     "train_save", "fwd_exp2", "gqa32_262k", "d128",
+                     "xprof"):
+        assert expected in tags, f"hw_session.sh lost step {expected}"
+
+
+def _bench_steps():
+    out = []
+    for tag, argv, env_extra in STEPS:
+        if "bench.py" not in " ".join(argv):
+            continue
+        args = list(argv[2:])  # strip "python bench.py"
+        seq_i = args.index("--worker") + 2
+        mode = args[seq_i + 1]
+        args[seq_i] = str(TINY_DECODE_SEQ if mode == "decode" else TINY_SEQ)
+        out.append((tag, args, env_extra))
+    return out
+
+
+BENCH_STEPS = _bench_steps()
+
+
+@pytest.fixture(scope="module")
+def preflight_records():
+    """Exec every queued bench-worker step in ONE subprocess.
+
+    Two constraints shape this (same as tests/test_graft_entry.py's bench
+    fixture): (a) this image's sitecustomize pre-imports jax and re-exports
+    JAX_PLATFORMS=axon in every python subprocess, so env vars can't force
+    CPU — only an in-process jax.config.update before exec'ing the script
+    can (passing env would silently probe the possibly-wedged TPU tunnel);
+    (b) a fresh jax import per step would cost ~10 s for every queued
+    bench step (len(BENCH_STEPS) of them) on this 1-CPU box, so all steps
+    share one interpreter."""
+    bench_path = os.path.join(REPO, "bench.py")
+    lines = [
+        "import json, os, sys, traceback",
+        "import jax; jax.config.update('jax_platforms', 'cpu')",
+    ]
+    for tag, args, env_extra in BENCH_STEPS:
+        lines += [f"os.environ[{k!r}] = {v!r}" for k, v in env_extra.items()]
+        lines += [
+            "try:",
+            f"    sys.argv = {['bench.py'] + args!r}",
+            f"    exec(open({bench_path!r}).read())",
+            "except Exception:",
+            f"    print(json.dumps({{'step_error': {tag!r},"
+            " 'tb': traceback.format_exc()[-600:]}))",
+        ]
+        lines += [f"os.environ.pop({k!r}, None)" for k in env_extra]
+    proc = subprocess.run(
+        [sys.executable, "-c", "\n".join(lines)], capture_output=True,
+        text=True, timeout=1800, env=dict(os.environ), cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.startswith("{")]
+    assert len(recs) == len(BENCH_STEPS), proc.stdout[-800:]
+    return dict(zip((t for t, _, _ in BENCH_STEPS), recs))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("tag", [t for t, _, _ in BENCH_STEPS])
+def test_bench_step_launches(tag, preflight_records):
+    """Each queued bench-worker step ran end-to-end at a tiny seq and
+    printed one parseable JSON measurement with a nonzero value."""
+    rec = preflight_records[tag]
+    assert "step_error" not in rec, f"{tag}:\n{rec.get('tb', '')}"
+    # metric key differs per mode: fwd/fwdbwd emit `value` (TFLOPs),
+    # train `tokens_per_sec`, decode `decode_ms_per_token`
+    metric = (rec.get("value", 0) or rec.get("tokens_per_sec", 0)
+              or rec.get("decode_ms_per_token", 0))
+    assert metric > 0, (tag, rec)
+
+
+def _run_tool(script_name, argv, timeout):
+    """Exec a tools/ script CPU-forced in-process (see preflight_records)."""
+    script = os.path.join(REPO, "tools", script_name)
+    wrapper = (
+        "import sys\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        f"sys.argv = {[script_name] + argv!r}\n"
+        # scripts resolve repo paths via __file__, which a bare exec lacks
+        f"exec(open({script!r}).read(),"
+        f" {{'__name__': '__main__', '__file__': {script!r}}})\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", wrapper], capture_output=True, text=True,
+        timeout=timeout, env=dict(os.environ), cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"{script_name} {argv}: rc={proc.returncode}"
+        f"\nstdout:{proc.stdout[-1500:]}\nstderr:{proc.stderr[-1500:]}"
+    )
+    return proc.stdout
+
+
+def _tool_step_args(tag, script_name):
+    """The QUEUED argv for a tools/ step (flag drift on the session line
+    must fail here, not at argparse inside a TPU window), with --seq
+    shrunk to CPU scale."""
+    matches = [(argv) for t, argv, _ in STEPS
+               if t == tag and script_name in " ".join(argv)]
+    assert matches, f"hw_session.sh lost the {tag} step"
+    args = list(matches[0][2:])  # strip "python tools/<script>"
+    if "--seq" in args:
+        args[args.index("--seq") + 1] = str(TINY_SEQ)
+    return args
+
+
+@pytest.mark.slow
+def test_kernel_validate_step_launches():
+    """tools/tpu_kernel_validate.py with the `validate` step's queued
+    flags (--sweep ...) completes at a tiny seq, with NO per-mode errors
+    (the tool prints {"mode": ..., "error": ...} and exits 0 on kernel
+    failures — a green run must mean every launch actually ran)."""
+    args = _tool_step_args("validate", "tpu_kernel_validate.py")
+    out = _run_tool(
+        "tpu_kernel_validate.py", args + ["--interpret"], timeout=900,
+    )
+    recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    errors = [r for r in recs if "error" in r]
+    assert not errors, errors
+    modes = {r.get("mode") for r in recs}
+    assert "fwd" in modes and "fwdbwd" in modes, modes
+
+
+@pytest.mark.slow
+def test_kernel_validate_bwd_sweep_launches():
+    """The `bwdsweep` step's queued flags: the per-pass block-override
+    path (the code that will pin DEFAULT_BLOCK_*_DKV/_DQ) runs end-to-end
+    at a tiny seq with no per-combination errors."""
+    args = _tool_step_args("bwdsweep", "tpu_kernel_validate.py")
+    out = _run_tool(
+        "tpu_kernel_validate.py", args + ["--interpret"], timeout=900,
+    )
+    recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    errors = [r for r in recs if "error" in r]
+    assert not errors, errors
+    modes = {r.get("mode") for r in recs}
+    assert "bwd-dkv-best" in modes and "bwd-dq-best" in modes, modes
+
+
+@pytest.mark.slow
+def test_xprof_step_launches(tmp_path):
+    """tools/xprof_capture.py with the `xprof` step's queued argv (plus
+    the tiny-seq/temp-dir overrides — docs/hwlogs/ is reserved for real
+    silicon traces) captures both trace phases and writes its summary."""
+    args = _tool_step_args("xprof", "xprof_capture.py")
+    out = _run_tool(
+        "xprof_capture.py",
+        args + ["--seq", str(TINY_SEQ), "--out-dir", str(tmp_path)],
+        timeout=900,
+    )
+    assert "train step loss=" in out, out[-1500:]
+    assert (tmp_path / "xprof_summary.txt").exists()

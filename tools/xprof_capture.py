@@ -25,6 +25,21 @@ SEQ = 65536  # warm-compile shape with known rates (68.7 TFLOPs fwd)
 HEADS, DIM_HEAD = 8, 64
 
 
+def _parse_args():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=SEQ,
+                    help="trace shape; the CPU preflight shrinks this so "
+                         "the capture path is launchable without silicon "
+                         "(kernels auto-select interpret mode off-TPU)")
+    ap.add_argument("--out-dir", default=None,
+                    help="trace/summary root override (the CPU preflight "
+                         "points this at a temp dir so docs/hwlogs/ only "
+                         "ever holds real silicon traces)")
+    return ap.parse_args()
+
+
 def _categorize(name: str) -> str:
     n = name.lower()
     if any(t in n for t in ("dot", "convolution", "matmul", "mxu")):
@@ -117,18 +132,24 @@ def main() -> int:
     from ring_attention_tpu.utils import enable_compile_cache
     from ring_attention_tpu.utils.profiling import trace
 
+    args = _parse_args()
+    seq = args.seq
+    trace_root, summary = TRACE_ROOT, SUMMARY
+    if args.out_dir:
+        trace_root = os.path.join(args.out_dir, "xprof")
+        summary = os.path.join(args.out_dir, "xprof_summary.txt")
     enable_compile_cache()
 
-    os.makedirs(TRACE_ROOT, exist_ok=True)
+    os.makedirs(trace_root, exist_ok=True)
     out: list[str] = []
     dev = jax.devices()[0]
     out.append(f"device: {dev.device_kind} ({dev.platform})")
 
     # --- phase 1: fused fwd kernel ------------------------------------
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q = jax.random.normal(ks[0], (1, HEADS, SEQ, DIM_HEAD), jnp.bfloat16)
-    k = jax.random.normal(ks[1], (1, HEADS, SEQ, DIM_HEAD), jnp.bfloat16)
-    v = jax.random.normal(ks[2], (1, HEADS, SEQ, DIM_HEAD), jnp.bfloat16)
+    q = jax.random.normal(ks[0], (1, HEADS, seq, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, HEADS, seq, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, HEADS, seq, DIM_HEAD), jnp.bfloat16)
 
     @jax.jit
     def fwd(q, k, v):
@@ -147,7 +168,7 @@ def main() -> int:
             f"bytes accessed={ca.get('bytes accessed', 0):.3e}"
         )
     jax.block_until_ready(fwd(q, k, v))  # warm outside the trace
-    fwd_dir = os.path.join(TRACE_ROOT, "fwd")
+    fwd_dir = os.path.join(trace_root, "fwd")
     with trace(fwd_dir):
         for _ in range(5):
             r = fwd(q, k, v)
@@ -162,7 +183,8 @@ def main() -> int:
 
     model = RingTransformer(
         num_tokens=256, dim=512, depth=2, causal=True, heads=HEADS,
-        dim_head=DIM_HEAD, bucket_size=2048, rotary=True, use_pallas=True,
+        dim_head=DIM_HEAD, bucket_size=min(2048, max(seq // 4, 8)),
+        rotary=True, use_pallas=True,
         remat=True, remat_policy="save_attn", dtype=jnp.bfloat16,
     )
     params = model.init(
@@ -172,14 +194,14 @@ def main() -> int:
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
     tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (1, SEQ + 1), 0, 256, jnp.int32
+        jax.random.PRNGKey(1), (1, seq + 1), 0, 256, jnp.int32
     )
     step = jax.jit(make_train_step(
         lambda p, t: model.apply(p, t, return_loss=True), opt
     ))
     params, opt_state, loss = step(params, opt_state, tokens)  # warm
     jax.block_until_ready(loss)
-    train_dir = os.path.join(TRACE_ROOT, "train")
+    train_dir = os.path.join(trace_root, "train")
     with trace(train_dir):
         params, opt_state, loss = step(params, opt_state, tokens)
         jax.block_until_ready(loss)
@@ -188,7 +210,7 @@ def main() -> int:
 
     text = "\n".join(out)
     print(text)
-    with open(SUMMARY, "w") as f:
+    with open(summary, "w") as f:
         f.write(text + "\n")
     return 0
 
