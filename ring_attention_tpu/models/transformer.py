@@ -82,6 +82,14 @@ class RingTransformer(nn.Module):
     # scan — costing only (b, n, dim) + (b, h, n) saved activations per
     # layer.  None = plain full-block remat.
     remat_policy: str | None = None
+    # chunked cross-entropy: compute the loss as a rematted lax.scan over
+    # sequence chunks of this size, so at most (b, chunk, vocab) logits
+    # ever materialize.  At a real LM vocab the full logits tensor is the
+    # long-context memory wall — (1, 262144, 50257) f32 is ~53 GB, more
+    # than attention remat saves — and neither materializing it nor the
+    # reference (which does, ref ring_attention.py:659-673) can train
+    # those shapes.  None = single dense logits+CE (fine for small vocab)
+    loss_chunk_size: int | None = None
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -212,6 +220,22 @@ class RingTransformer(nn.Module):
             x = ff(x) + x
 
         x = self.final_norm(x)
+
+        if return_loss and self.loss_chunk_size:
+            # the (b, n, vocab) logits never materialize: un-permute the
+            # (b, n, dim) features instead (CE is position-local, so the
+            # layout permutation only has to line features up with labels)
+            # and scan the projection+CE over sequence chunks
+            if ring > 1 and self.auto_shard:
+                if striped:
+                    x = stripe_unpermute(x, ring)
+                elif zigzag:
+                    x = zigzag_unpermute(x, ring)
+                x = x[:, :n_orig]
+            return self._chunked_ce(
+                x, labels, self._valid_labels(labels, example_mask)
+            )
+
         logits = self.to_logits(x)
 
         if ring > 1 and self.auto_shard:
@@ -224,14 +248,73 @@ class RingTransformer(nn.Module):
         if not return_loss:
             return logits
 
-        # Cross-entropy with ignore_index (ref ring_attention.py:664-673)
+        # Cross-entropy with ignore_index (ref ring_attention.py:664-673).
+        # nll = logsumexp - chosen logit: same value as log_softmax+gather
+        # without materializing a second (b, n, vocab) f32 array
+        valid = self._valid_labels(labels, example_mask)
+        safe_labels = jnp.where(valid, labels, 0)
+        lf = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        chosen = jnp.take_along_axis(lf, safe_labels[..., None], axis=-1)[..., 0]
+        nll = lse - chosen
+        return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+    def _valid_labels(
+        self, labels: jax.Array, example_mask: jax.Array | None
+    ) -> jax.Array:
+        """Which (b, n) label slots count toward the loss — the ONE place
+        the ignore_index / example_mask rule lives (both CE paths use it)."""
         valid = labels != self.ignore_index
         if example_mask is not None:
             valid = valid & example_mask[:, None]
-        safe_labels = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-        return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+        return valid
+
+    def _chunked_ce(
+        self,
+        x: jax.Array,  # (b, n, dim) final-norm features
+        labels: jax.Array,  # (b, n)
+        valid: jax.Array,  # (b, n) bool, from _valid_labels
+    ) -> jax.Array:
+        """Cross-entropy as a rematted scan over sequence chunks.
+
+        Peak memory is one chunk's logits ``(b, chunk, vocab)`` — forward
+        AND backward (the remat recomputes each chunk's projection in the
+        grad pass; dW accumulates across scan steps).  Value-identical to
+        the dense path (same f32 lse-minus-chosen per position)."""
+        b, n, _ = x.shape
+        c = self.loss_chunk_size
+        x, _ = pad_to_multiple(x, c)
+        labels, _ = pad_to_multiple(labels, c)
+        valid, _ = pad_to_multiple(valid, c, value=False)
+        nc = x.shape[1] // c
+        xs = (
+            x.reshape(b, nc, c, x.shape[-1]).transpose(1, 0, 2, 3),
+            labels.reshape(b, nc, c).transpose(1, 0, 2),
+            valid.reshape(b, nc, c).transpose(1, 0, 2),
+        )
+
+        def body(mdl, carry, inp):
+            x_c, lab_c, val_c = inp
+            lf = mdl.to_logits(x_c).astype(jnp.float32)
+            lse = jax.nn.logsumexp(lf, axis=-1)
+            chosen = jnp.take_along_axis(
+                lf, jnp.where(val_c, lab_c, 0)[..., None], axis=-1
+            )[..., 0]
+            nll = jnp.where(val_c, lse - chosen, 0.0)
+            s, cnt = carry
+            return (s + nll.sum(), cnt + val_c.sum()), None
+
+        scan = nn.scan(
+            nn.remat(body, prevent_cse=False),
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        (total, count), _ = scan(
+            self, (jnp.float32(0.0), jnp.int32(0)), xs
+        )
+        return total / jnp.maximum(count, 1)
 
     # ------------------------------------------------------------------
     # Incremental decoding

@@ -325,3 +325,57 @@ def test_ring_dkv_dtype_through_model(rng, mesh):
     for a, b in zip(jax.tree.leaves(g16), jax.tree.leaves(g32)):
         assert bool(jnp.isfinite(a).all())
         np.testing.assert_allclose(a, b, atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("chunk,ring", [(8, False), (5, False), (8, True)])
+def test_chunked_ce_matches_dense(rng, chunk, ring):
+    """loss_chunk_size: the rematted chunk-scan loss (and its gradients)
+    equals the dense logits+CE path — including a chunk size that doesn't
+    divide the sequence, ignore_index labels, and the striped-ring path
+    where the features (not the logits) get un-permuted."""
+    kw = dict(
+        num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
+        causal=True, bucket_size=8,
+        **(dict(mesh=create_mesh(ring_size=8), striped=True)
+           if ring else dict(use_ring=False)),
+    )
+    dense = RingTransformer(**kw)
+    chunked = RingTransformer(loss_chunk_size=chunk, **kw)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 33)), jnp.int32)
+    tokens = tokens.at[0, 20:].set(-1)  # ignore_index tail in row 0
+    params = dense.init(jax.random.PRNGKey(0), jnp.abs(tokens))
+
+    def loss_fn(model):
+        return lambda p: model.apply(p, tokens, return_loss=True)
+
+    ld = loss_fn(dense)(params)
+    lc = loss_fn(chunked)(params)
+    np.testing.assert_allclose(lc, ld, rtol=2e-6)
+
+    gd = jax.grad(loss_fn(dense))(params)
+    gc = jax.grad(loss_fn(chunked))(params)
+    flat_d = jax.tree_util.tree_leaves_with_path(gd)
+    flat_c = {jax.tree_util.keystr(p): l
+              for p, l in jax.tree_util.tree_leaves_with_path(gc)}
+    for p, leaf in flat_d:
+        key = jax.tree_util.keystr(p)
+        np.testing.assert_allclose(
+            flat_c[key], leaf, atol=5e-6, err_msg=key
+        )
+
+
+def test_chunked_ce_program_does_not_materialize_logits(rng):
+    """The chunked-loss jaxpr must contain no (b, n, vocab) intermediate —
+    the whole point is that only (b, chunk, vocab) logits ever exist."""
+    n, chunk = 64, 8
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=16, depth=1, heads=2, dim_head=8,
+        causal=True, bucket_size=8, use_ring=False, loss_chunk_size=chunk,
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (1, n + 1)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    jaxpr = jax.make_jaxpr(
+        lambda p: model.apply(p, tokens, return_loss=True)
+    )(params)
+    full = f"1,{n},{VOCAB}"
+    assert full not in str(jaxpr), f"found full-logits shape ({full})"
