@@ -107,7 +107,9 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     enable_compile_cache()
 
     if mode == "train":
-        _train_worker(impl, seq_len, extra.get("remat_policy"))
+        _train_worker(impl, seq_len, extra.get("remat_policy"),
+                      vocab=extra.get("vocab", 256),
+                      loss_chunk_size=extra.get("loss_chunk_size"))
         return
     if mode == "hops":
         _hops_worker(seq_len, int(extra.get("ring", 4)))
@@ -372,12 +374,17 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
     )
 
 
-def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
+def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
+                  vocab: int = 256,
+                  loss_chunk_size: int | None = None) -> None:
     """Full train step (fwd+bwd+adam) tokens/sec on one chip.
 
     ``remat_policy="save_attn"`` saves each layer's flash output + lse so
     the backward skips re-running the O(n^2) attention forward (VERDICT r2
-    weak #1: the elective recompute cost the r2 headline ~2 s/step)."""
+    weak #1: the elective recompute cost the r2 headline ~2 s/step).
+    ``vocab``/``loss_chunk_size`` measure the realistic-vocabulary
+    configuration: at vocab 50257 the full-logits CE cannot fit a chip at
+    262k tokens, so the chunked loss is what makes the shape trainable."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -386,7 +393,7 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
 
     dev, _ = _device_peak()
     model = RingTransformer(
-        num_tokens=256,
+        num_tokens=vocab,
         dim=512,
         depth=2,
         causal=True,
@@ -397,6 +404,7 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
         use_pallas=(impl == "pallas"),
         remat=True,
         remat_policy=remat_policy,
+        loss_chunk_size=loss_chunk_size,
         dtype=jnp.bfloat16,
     )
     # params are seq-independent: init on a short sequence to keep init cheap
@@ -406,7 +414,7 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
     opt_state = opt.init(params)
 
     tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (1, seq_len + 1), 0, 256, jnp.int32
+        jax.random.PRNGKey(1), (1, seq_len + 1), 0, vocab, jnp.int32
     )
 
     from ring_attention_tpu.utils import make_train_step
@@ -441,6 +449,9 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None) -> None:
                 "train_seq_len": seq_len,
                 "train_impl": impl,
                 "train_remat_policy": remat_policy or "full",
+                "train_vocab": vocab,
+                **({"train_loss_chunk_size": loss_chunk_size}
+                   if loss_chunk_size else {}),
                 "train_ms_per_step": round(secs * 1e3, 2),
                 "train_compile_s": round(compile_s, 1),
                 "train_loss": round(float(loss), 4),

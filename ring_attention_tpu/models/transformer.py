@@ -282,7 +282,9 @@ class RingTransformer(nn.Module):
         grad pass; dW accumulates across scan steps).  Value-identical to
         the dense path (same f32 lse-minus-chosen per position)."""
         b, n, _ = x.shape
-        c = self.loss_chunk_size
+        # clamp: padding a short sequence UP to the chunk size would make
+        # peak memory/compute strictly worse than the dense path
+        c = min(self.loss_chunk_size, n)
         x, _ = pad_to_multiple(x, c)
         labels, _ = pad_to_multiple(labels, c)
         valid, _ = pad_to_multiple(valid, c, value=False)

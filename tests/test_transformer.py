@@ -327,17 +327,23 @@ def test_ring_dkv_dtype_through_model(rng, mesh):
         np.testing.assert_allclose(a, b, atol=3e-2, rtol=3e-2)
 
 
-@pytest.mark.parametrize("chunk,ring", [(8, False), (5, False), (8, True)])
-def test_chunked_ce_matches_dense(rng, chunk, ring):
+@pytest.mark.parametrize("chunk,layout", [
+    (8, "local"), (5, "local"), (64, "local"),  # 64 > n: clamp path
+    (8, "striped"), (8, "zigzag"),
+])
+def test_chunked_ce_matches_dense(rng, chunk, layout):
     """loss_chunk_size: the rematted chunk-scan loss (and its gradients)
     equals the dense logits+CE path — including a chunk size that doesn't
-    divide the sequence, ignore_index labels, and the striped-ring path
-    where the features (not the logits) get un-permuted."""
+    divide the sequence, one larger than the sequence (clamped), an
+    ignore_index tail, and the striped/zig-zag paths where the features
+    (not the logits) get un-permuted."""
     kw = dict(
         num_tokens=VOCAB, dim=32, depth=2, heads=4, dim_head=8,
         causal=True, bucket_size=8,
-        **(dict(mesh=create_mesh(ring_size=8), striped=True)
-           if ring else dict(use_ring=False)),
+        **({"local": dict(use_ring=False),
+            "striped": dict(mesh=create_mesh(ring_size=8), striped=True),
+            "zigzag": dict(mesh=create_mesh(ring_size=8),
+                           sequence_parallel="zigzag")}[layout]),
     )
     dense = RingTransformer(**kw)
     chunked = RingTransformer(loss_chunk_size=chunk, **kw)

@@ -85,6 +85,10 @@ run bwdsweep 1800 python tools/tpu_kernel_validate.py --bwd-sweep --seq 262144
 # 5. train headline, both remat variants (save_attn expected >30k tok/s)
 run train_save 1200 python bench.py --worker pallas 262144 train '{"remat_policy": "save_attn"}'
 run train_full 1200 python bench.py --worker pallas 262144 train '{}'
+# 5a. realistic vocabulary: 262k tokens x 50k vocab trains on ONE chip
+#     only because the chunked CE never materializes the ~53 GB logits
+#     (models/transformer.py loss_chunk_size)
+run train_vocab50k 1500 python bench.py --worker pallas 262144 train '{"remat_policy": "save_attn", "vocab": 50257, "loss_chunk_size": 8192}'
 # 5b. log2-space scoring A/B (RING_ATTN_EXP2=1, docs/hardware_log.md
 #     round-5 roofline note): candidate VPU win, zero if exp and exp2
 #     dispatch at the same rate.  Same shapes as the standing fwd/fwdbwd
