@@ -36,6 +36,10 @@ def main() -> None:
                     help="gradient-accumulation microbatches per update")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize blocks (activation memory savings)")
+    ap.add_argument("--loss-chunk-size", type=int, default=None,
+                    help="chunked cross-entropy: at most (batch, chunk, "
+                         "vocab) logits materialize — required at real LM "
+                         "vocabularies with long sequences")
     ap.add_argument("--use-pallas", action="store_true",
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     ap.add_argument("--bidirectional", action="store_true",
@@ -78,6 +82,7 @@ def main() -> None:
         use_pallas=args.use_pallas,
         ring_bidirectional=args.bidirectional,
         remat=args.remat,
+        loss_chunk_size=args.loss_chunk_size,
         dtype=jnp.bfloat16 if args.bf16 else None,
     )
 

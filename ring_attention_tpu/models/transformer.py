@@ -36,6 +36,26 @@ from .attention import RingAttention
 from .layers import FeedForward, RMSNorm
 
 
+def _position_nll(
+    logits: jax.Array,  # (..., vocab), any float dtype
+    labels: jax.Array,  # (...)
+    valid: jax.Array,  # (...) bool
+) -> jax.Array:
+    """Per-position negative log likelihood, zero where invalid.
+
+    ``nll = logsumexp - chosen logit`` in f32: the same value as
+    ``log_softmax`` + gather without materializing a second
+    ``(..., vocab)`` f32 array.  THE loss math shared by the dense and
+    chunked CE paths — the chunked path's value-identity guarantee
+    depends on both calling exactly this."""
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    chosen = jnp.take_along_axis(
+        lf, jnp.where(valid, labels, 0)[..., None], axis=-1
+    )[..., 0]
+    return jnp.where(valid, lse - chosen, 0.0)
+
+
 class RingTransformer(nn.Module):
     num_tokens: int
     dim: int
@@ -248,16 +268,10 @@ class RingTransformer(nn.Module):
         if not return_loss:
             return logits
 
-        # Cross-entropy with ignore_index (ref ring_attention.py:664-673).
-        # nll = logsumexp - chosen logit: same value as log_softmax+gather
-        # without materializing a second (b, n, vocab) f32 array
+        # Cross-entropy with ignore_index (ref ring_attention.py:664-673)
         valid = self._valid_labels(labels, example_mask)
-        safe_labels = jnp.where(valid, labels, 0)
-        lf = logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(lf, axis=-1)
-        chosen = jnp.take_along_axis(lf, safe_labels[..., None], axis=-1)[..., 0]
-        nll = lse - chosen
-        return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+        nll = _position_nll(logits, labels, valid)
+        return nll.sum() / jnp.maximum(valid.sum(), 1)
 
     def _valid_labels(
         self, labels: jax.Array, example_mask: jax.Array | None
@@ -297,12 +311,7 @@ class RingTransformer(nn.Module):
 
         def body(mdl, carry, inp):
             x_c, lab_c, val_c = inp
-            lf = mdl.to_logits(x_c).astype(jnp.float32)
-            lse = jax.nn.logsumexp(lf, axis=-1)
-            chosen = jnp.take_along_axis(
-                lf, jnp.where(val_c, lab_c, 0)[..., None], axis=-1
-            )[..., 0]
-            nll = jnp.where(val_c, lse - chosen, 0.0)
+            nll = _position_nll(mdl.to_logits(x_c), lab_c, val_c)
             s, cnt = carry
             return (s + nll.sum(), cnt + val_c.sum()), None
 

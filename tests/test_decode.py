@@ -470,3 +470,20 @@ def test_ring_prefill_then_decode(rng):
     for i in (9, 10):
         logits, cache = step(params, tokens[:, i], cache, jnp.int32(i))
         np.testing.assert_allclose(logits, full[:, i], atol=ATOL)
+
+
+def test_decode_matches_forward_ulysses(rng):
+    """Decode is SP-scheme-independent: a model configured with ulysses
+    sequence parallelism for training still decodes via the contiguous
+    sharded cache + tree merge, and must reproduce ITS full forward."""
+    mesh = create_mesh(ring_size=8)
+    model = RingTransformer(
+        num_tokens=VOCAB, dim=32, depth=2, heads=8, dim_head=8,
+        causal=True, bucket_size=8, kv_heads=2, mesh=mesh,
+        sequence_parallel="ulysses",
+    )
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    full = model.apply(params, tokens)
+    inc = _decode_all(model, params, tokens, max_len=16)
+    np.testing.assert_allclose(inc, full, atol=ATOL)

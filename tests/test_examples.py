@@ -52,11 +52,11 @@ def test_train_example_smoke():
 
 
 @pytest.mark.slow
-def test_train_example_accum_remat():
+def test_train_example_accum_remat_chunked_ce():
     out = _run_example(
         "train.py", "--fake-devices", "8", "--steps", "2",
         "--seq-len", "64", "--dim", "32", "--batch", "2",
-        "--accum-steps", "2", "--remat",
+        "--accum-steps", "2", "--remat", "--loss-chunk-size", "16",
     )
     assert "loss" in out
 

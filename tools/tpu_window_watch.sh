@@ -16,11 +16,22 @@ set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${1:-600}
 LOCK=/tmp/tpu_window_watch.lock
+# PID-stamped lock with staleness takeover: a SIGKILLed watcher (EXIT trap
+# never runs) must not permanently block future watchers — an unwatched
+# window opening unnoticed is the exact failure this tool prevents.
 if ! mkdir "$LOCK" 2>/dev/null; then
-  echo "another window watcher is running ($LOCK exists)" >&2
-  exit 1
+  oldpid=$(cat "$LOCK/pid" 2>/dev/null)
+  if [ -n "$oldpid" ] && kill -0 "$oldpid" 2>/dev/null; then
+    echo "another window watcher is running (pid $oldpid)" >&2
+    echo "$(date -u +%H:%M:%S) watcher refused: pid $oldpid alive" >> /tmp/tpu_health.log
+    exit 1
+  fi
+  echo "$(date -u +%H:%M:%S) stale watcher lock (pid ${oldpid:-unknown} dead), taking over" >> /tmp/tpu_health.log
+  rm -rf "$LOCK"
+  mkdir "$LOCK" || exit 1
 fi
-trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+echo $$ > "$LOCK/pid"
+trap 'rm -rf "$LOCK" 2>/dev/null' EXIT
 
 while true; do
   touch /tmp/tpu_probe.lock
