@@ -44,6 +44,18 @@ def main() -> None:
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     ap.add_argument("--bidirectional", action="store_true",
                     help="circulate KV halves both ring directions (duplex ICI)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint directory: saves every --ckpt-every "
+                         "steps and resumes from the last good checkpoint "
+                         "(kill the run mid-way and rerun the same command)")
+    ap.add_argument("--ckpt-every", type=int, default=5)
+    ap.add_argument("--ckpt-keep", type=int, default=3,
+                    help="keep-last-N checkpoint retention")
+    ap.add_argument("--skip-nonfinite", action="store_true",
+                    help="guarded train step: skip (don't apply) optimizer "
+                         "updates whose loss/grads are non-finite")
+    ap.add_argument("--clip-grad-norm", type=float, default=None,
+                    help="clip gradients to this global L2 norm")
     args = ap.parse_args()
 
     if args.fake_devices:
@@ -61,7 +73,12 @@ def main() -> None:
 
     from ring_attention_tpu import RingTransformer, create_mesh
     from ring_attention_tpu.parallel import shard_batch
-    from ring_attention_tpu.utils import StepTimer, make_train_step
+    from ring_attention_tpu.utils import (
+        CheckpointManager,
+        StepTimer,
+        init_step_stats,
+        make_train_step,
+    )
 
     n_dev = len(jax.devices())
     ring = args.ring_size or n_dev
@@ -102,20 +119,54 @@ def main() -> None:
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)
 
+    guarded = args.skip_nonfinite
     train_step = jax.jit(make_train_step(
         lambda p, t: model.apply(p, t, return_loss=True), opt,
         accum_steps=args.accum_steps,
+        skip_nonfinite=guarded,
+        clip_grad_norm=args.clip_grad_norm,
     ))
 
+    # preemption-safe resume: atomic saves, keep-last-N, corrupt-checkpoint
+    # fallback — kill this process at any point and rerun the same command
+    # to continue from the last good step (see docs/resilience.md)
+    mgr = None
+    start = 0
+    stats = init_step_stats()
+    if args.ckpt_dir:
+        mgr = CheckpointManager(args.ckpt_dir, keep=args.ckpt_keep)
+        # stats ride along in the checkpoint so a resumed guarded run
+        # keeps its skipped-step telemetry (a growing skip streak is the
+        # "this run diverged" signal and must survive preemption)
+        state, start = mgr.resume_or_init(
+            lambda: {"params": params, "opt_state": opt_state,
+                     "stats": stats}
+        )
+        params, opt_state = state["params"], state["opt_state"]
+        stats = state["stats"]
+        if start:
+            print(f"resumed from checkpoint (continuing at step {start})")
     timer = StepTimer(tokens_per_step=tokens.size)
-    for step in range(args.steps):
-        params, opt_state, loss = train_step(params, opt_state, tokens)
+    for step in range(start, args.steps):
+        if guarded:
+            params, opt_state, stats, loss = train_step(
+                params, opt_state, stats, tokens
+            )
+        else:
+            params, opt_state, loss = train_step(params, opt_state, tokens)
         timer.step(loss)
         if step % 5 == 0 or step == args.steps - 1:
+            skipped = int(stats.skipped) if guarded else 0
             print(
                 f"step {step:4d}  loss {float(loss):.4f}  "
                 f"{timer.tokens_per_sec:,.0f} tok/s"
+                + (f"  [skipped {skipped}]" if skipped else "")
             )
+        if mgr is not None and (
+            step % args.ckpt_every == 0 or step == args.steps - 1
+        ):
+            mgr.save(step, {"params": params, "opt_state": opt_state,
+                            "stats": stats})
 
 
 if __name__ == "__main__":

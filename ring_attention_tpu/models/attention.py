@@ -45,6 +45,7 @@ from ..parallel.sharding import pad_seq_and_mask, stripe_permute, stripe_unpermu
 from ..parallel.tree_decode import tree_attn_decode
 from ..parallel.ulysses import ulysses_attention
 from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
+from ..utils import compat
 from ..utils.validate import check_model_input
 from .layers import RMSNorm
 
@@ -73,6 +74,13 @@ class RingAttention(nn.Module):
     auto_shard: bool = False
     mesh: Mesh | None = None
     use_pallas: bool = False
+    # kernel-path selection with graceful degradation (overrides use_pallas
+    # when set): "pallas" | "xla" | "auto".  "auto" resolves through
+    # utils/resilience.py at trace time — the Pallas kernels when a
+    # one-shot compile probe passes, the XLA flash path otherwise, with a
+    # one-shot warning and a queryable degradation record.  use_pallas
+    # remains as the explicit legacy switch.
+    impl: str | None = None
     # split the (non-ring) pallas launch into this many per-head-group
     # kernel programs — bit-identical results; the escape hatch for
     # compiler/relay program-size limits at large heads x seq (see
@@ -112,6 +120,14 @@ class RingAttention(nn.Module):
         kvh = self.kv_heads or self.heads
         assert self.heads % kvh == 0
         return kvh
+
+    def _use_pallas(self) -> bool:
+        """Resolve the kernel path for this call (trace time, cached probe)."""
+        if self.impl is None:
+            return self.use_pallas
+        from ..utils import resilience
+
+        return resilience.resolve_attention_impl(self.impl) == "pallas"
 
     def _ring_size(self) -> int:
         if self.mesh is None:
@@ -212,7 +228,7 @@ class RingAttention(nn.Module):
                 q, k, v, mask, causal=self.causal,
                 softclamp_value=self.softclamp_value,
             )
-        if self.use_pallas:
+        if self._use_pallas():
             return pallas_flash_attention(
                 q, k, v, mask, causal=self.causal, window=window,
                 softclamp_value=self.softclamp_value,
@@ -253,14 +269,14 @@ class RingAttention(nn.Module):
                 q, k, v, SEQ_AXIS,
                 bucket_size=self.bucket_size,
                 softclamp_value=self.softclamp_value,
-                impl="pallas" if self.use_pallas else "xla",
+                impl="pallas" if self._use_pallas() else "xla",
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
-        return jax.shard_map(
+        return compat.shard_map(
             core, mesh=self.mesh,
             in_specs=(qspec, qspec, qspec), out_specs=qspec,
-            check_vma=not self.use_pallas,
+            check_vma=not self._use_pallas(),
         )(q, k, v)
 
     def _ulysses_attend(self, q, k, v, mask):
@@ -281,15 +297,15 @@ class RingAttention(nn.Module):
                 bucket_size=self.bucket_size,
                 window=self.max_lookback_seq_len,
                 softclamp_value=self.softclamp_value,
-                impl="pallas" if self.use_pallas else "xla",
+                impl="pallas" if self._use_pallas() else "xla",
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
         mspec = P(DATA_AXIS, SEQ_AXIS) if mask is not None else P()
-        return jax.shard_map(
+        return compat.shard_map(
             core, mesh=self.mesh,
             in_specs=(qspec, qspec, qspec, mspec), out_specs=qspec,
-            check_vma=not self.use_pallas,
+            check_vma=not self._use_pallas(),
         )(q, k, v, mask)
 
     def _ring_attend(self, q, k, v, mask):
@@ -339,20 +355,20 @@ class RingAttention(nn.Module):
                 self.causal, self.striped,
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
-                "pallas" if self.use_pallas else "xla",
+                "pallas" if self._use_pallas() else "xla",
                 bidirectional, self.ring_dkv_dtype,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
         mspec = P(DATA_AXIS, SEQ_AXIS) if mask is not None else P()
-        return jax.shard_map(
+        return compat.shard_map(
             core,
             mesh=self.mesh,
             in_specs=(qspec, qspec, qspec, mspec),
             out_specs=qspec,
             # pallas_call with device-varying scalars trips jax's vma
             # checker; jax suggests check_vma=False as the workaround
-            check_vma=not self.use_pallas,
+            check_vma=not self._use_pallas(),
         )(q, k, v, mask)
 
     # ------------------------------------------------------------------
@@ -399,7 +415,7 @@ class RingAttention(nn.Module):
             )
             kv = QuantizedKV(*cache_k, *cache_v)
             kv_mask = self._buffer_mask(size, pos, x.shape[0])
-            if self.use_pallas:
+            if self._use_pallas():
                 out, _ = pallas_flash_decode_q8(
                     q, kv, kv_mask, softclamp_value=self.softclamp_value,
                 )
@@ -415,7 +431,7 @@ class RingAttention(nn.Module):
             cache_k = lax.dynamic_update_slice_in_dim(cache_k, k.astype(cache_k.dtype), slot, axis=2)
             cache_v = lax.dynamic_update_slice_in_dim(cache_v, v.astype(cache_v.dtype), slot, axis=2)
             kv_mask = self._buffer_mask(size, pos, x.shape[0])
-            if self.use_pallas:
+            if self._use_pallas():
                 # single-sweep decode kernel: each cache byte read once per
                 # kv head, normalized output written in-kernel
                 out, _ = pallas_flash_decode(
@@ -575,17 +591,17 @@ class RingAttention(nn.Module):
                 True, False,  # causal, contiguous (non-striped) layout
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
-                "pallas" if self.use_pallas else "xla",
+                "pallas" if self._use_pallas() else "xla",
                 bidirectional, self.ring_dkv_dtype,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
-        out = jax.shard_map(
+        out = compat.shard_map(
             core,
             mesh=self.mesh,
             in_specs=(qspec, qspec, qspec),
             out_specs=qspec,
-            check_vma=not self.use_pallas,
+            check_vma=not self._use_pallas(),
         )(q, k, v)
         return out[:, :, :n]
 
@@ -632,7 +648,7 @@ class RingAttention(nn.Module):
                     q, None, None, kv_mask,
                     axis_name=SEQ_AXIS,
                     softclamp_value=self.softclamp_value,
-                    impl=None if self.use_pallas else "xla",
+                    impl=None if self._use_pallas() else "xla",
                     kv_quantized=kvq,
                 )
             else:
@@ -640,7 +656,7 @@ class RingAttention(nn.Module):
                     q, cache_k, cache_v, kv_mask,
                     axis_name=SEQ_AXIS,
                     softclamp_value=self.softclamp_value,
-                    impl="pallas" if self.use_pallas else "xla",
+                    impl="pallas" if self._use_pallas() else "xla",
                 )
             return out, cache_k, cache_v
 
@@ -648,10 +664,10 @@ class RingAttention(nn.Module):
         sspec = P(DATA_AXIS, None, SEQ_AXIS)
         cache_spec = (cspec, sspec) if quant else cspec
         rep = P(DATA_AXIS, None, None, None)
-        return jax.shard_map(
+        return compat.shard_map(
             core,
             mesh=self.mesh,
             in_specs=(rep, rep, rep, cache_spec, cache_spec, P()),
             out_specs=(rep, cache_spec, cache_spec),
-            check_vma=not self.use_pallas,
+            check_vma=not self._use_pallas(),
         )(q, k, v, cache_k, cache_v, pos)

@@ -77,6 +77,10 @@ class RingTransformer(nn.Module):
     auto_shard: bool = True
     mesh: Mesh | None = None
     use_pallas: bool = False
+    # kernel-path selection with graceful degradation, forwarded to every
+    # RingAttention layer (see models/attention.py ``impl``): "pallas" |
+    # "xla" | "auto"; None keeps the explicit use_pallas switch
+    impl: str | None = None
     # see RingAttention.pallas_head_chunks (program-size escape hatch)
     pallas_head_chunks: int | None = None
     # see RingAttention.quantize_cache (int8 decode KV cache)
@@ -113,6 +117,16 @@ class RingTransformer(nn.Module):
     dtype: jnp.dtype | None = None
 
     def setup(self):
+        # a negative chunk size used to surface as an obscure shape error
+        # deep inside pad_to_multiple, and 0 silently disabled chunking via
+        # the falsy check in __call__ — validate once, loudly, up front
+        if self.loss_chunk_size is not None and self.loss_chunk_size <= 0:
+            raise ValueError(
+                f"RingTransformer: loss_chunk_size must be None or a "
+                f"positive int, got {self.loss_chunk_size!r} (None disables "
+                f"chunking; 0 would silently disable it, a negative value "
+                f"breaks padding)"
+            )
         self.embed = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype)
         # flax-lifted remat (NOT raw jax.checkpoint: param creation during
         # init is a side effect that would leak tracers out of the
@@ -148,6 +162,7 @@ class RingTransformer(nn.Module):
                 auto_shard=False,  # sharded once at model top
                 mesh=self.mesh,
                 use_pallas=self.use_pallas,
+                impl=self.impl,
                 pallas_head_chunks=self.pallas_head_chunks,
                 quantize_cache=self.quantize_cache,
                 sequence_parallel=self.sequence_parallel,

@@ -16,7 +16,92 @@ from .pallas_flash import (
 )
 from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
 
+
+def attention(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    causal: bool = False,
+    window: int | None = None,
+    softclamp_value: float | None = None,
+    impl: str = "auto",
+    bucket_size: int | None = None,
+    q_chunk_size: int | None = None,
+    head_chunks: int | None = None,
+    interpret: bool | None = None,
+):
+    """Single-device attention entry point with graceful kernel degradation.
+
+    ``impl`` selects the kernel path:
+
+    - ``"pallas"`` — the Mosaic kernels (:func:`pallas_flash_attention`);
+      failures propagate (an explicit request must fail loudly).
+    - ``"xla"`` — the pure-XLA flash path (:func:`flash_attention`).
+    - ``"auto"`` (default) — try Pallas, FALL BACK to XLA when the Pallas
+      path cannot compile/lower on this backend (missing plugin, Mosaic
+      rejection, older jax).  The first failure emits one warning and is
+      recorded in ``ring_attention_tpu.utils.resilience.degradation`` —
+      queryable, so a run that silently lost its fast kernels is
+      distinguishable from one that never had them.  Resolution happens at
+      trace time (an outer ``jax.jit`` compiles exactly one path), backed
+      by a tiny one-shot compile probe so the choice is made *before* a
+      caller's multi-minute compile bakes it in.  On non-TPU backends
+      ``auto`` takes XLA silently (no degradation record): interpret-mode
+      Pallas would be a pessimization there, not a fallback.
+
+    ``bucket_size``/``q_chunk_size`` apply to the XLA path,
+    ``head_chunks``/``interpret`` to the Pallas path; both sets are legal
+    with ``impl="auto"`` (whichever path runs uses its own).
+    """
+    from ..utils import resilience
+    from ..utils.validate import check_attention_args
+
+    # validate BEFORE any fallback machinery: a caller's input error must
+    # raise as itself, never be mistaken for a kernel failure and mark
+    # the Pallas path degraded for the rest of the process
+    check_attention_args("attention", q, k, v, mask)
+    if head_chunks is not None and head_chunks > 1:
+        h, hk = q.shape[1], k.shape[1]
+        if h % head_chunks or hk % head_chunks:
+            raise ValueError(
+                f"attention: head_chunks={head_chunks} must divide both "
+                f"heads={h} and kv_heads={hk}"
+            )
+
+    def run_xla():
+        return flash_attention(
+            q, k, v, mask, causal=causal, window=window,
+            softclamp_value=softclamp_value, bucket_size=bucket_size,
+            q_chunk_size=q_chunk_size,
+        )
+
+    def run_pallas():
+        resilience.get_injector().check(resilience.PALLAS_FAULT)
+        return pallas_flash_attention(
+            q, k, v, mask, causal=causal, window=window,
+            softclamp_value=softclamp_value, head_chunks=head_chunks,
+            interpret=interpret,
+        )
+
+    resolved = resilience.resolve_attention_impl(impl)
+    if resolved == "xla":
+        return run_xla()
+    if impl != "auto":
+        return run_pallas()
+    try:
+        # probe passed, but this call's exact shape/config can still hit a
+        # trace-time lowering failure — catch it and degrade rather than
+        # kill a run the XLA path could have carried
+        return run_pallas()
+    except Exception as e:  # noqa: BLE001 — any Pallas failure degrades
+        resilience.degradation.record(resilience.PALLAS_COMPONENT, e)
+        return run_xla()
+
+
 __all__ = [
+    "attention",
     "QuantizedKV",
     "pallas_flash_attention",
     "pallas_flash_decode",

@@ -43,6 +43,7 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from .attention import MASK_VALUE, EPSILON, softclamp
+from ..utils import compat
 from ..utils.validate import check_attention_args
 
 
@@ -67,12 +68,12 @@ def match_vma(x: jax.Array, like: jax.Array) -> jax.Array:
     varying type of data derived from sharded inputs.  No-op outside
     shard_map.
     """
-    want = getattr(jax.typeof(like), "vma", frozenset())
-    have = getattr(jax.typeof(x), "vma", frozenset())
+    want = getattr(compat.typeof(like), "vma", frozenset())
+    have = getattr(compat.typeof(x), "vma", frozenset())
     missing = tuple(want - have)
     if not missing:
         return x
-    return lax.pcast(x, missing, to="varying")
+    return compat.pcast(x, missing, to="varying")
 
 
 def init_carry(

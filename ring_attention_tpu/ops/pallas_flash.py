@@ -46,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import EPSILON, MASK_VALUE
+from ..utils import compat
 from ..utils.validate import check_attention_args
 
 # Tuned on TPU v5e (seq 262144, h=8, d=64, bf16, causal): 1024x1024 won both
@@ -74,20 +75,20 @@ def _unify_vma(*arrays):
     union = set()
     for a in arrays:
         if a is not None:
-            union |= set(getattr(jax.typeof(a), "vma", frozenset()))
+            union |= set(getattr(compat.typeof(a), "vma", frozenset()))
 
     def cast(a):
         if a is None:
             return None
-        missing = tuple(union - set(getattr(jax.typeof(a), "vma", frozenset())))
-        return lax.pcast(a, missing, to="varying") if missing else a
+        missing = tuple(union - set(getattr(compat.typeof(a), "vma", frozenset())))
+        return compat.pcast(a, missing, to="varying") if missing else a
 
     return [cast(a) for a in arrays]
 
 
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct matching ``like``'s shard_map varying-axes type."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    vma = getattr(compat.typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -116,6 +117,22 @@ LN2 = 0.6931471805599453
 
 
 def _exp2_default() -> bool:
+    """Env-var default for log2-space scoring — read at TRACE time.
+
+    The flag is captured when a caller traces (first call / new shapes),
+    so toggling ``RING_ATTN_EXP2`` after a jitted caller has compiled
+    silently has no effect on that compilation — an A/B harness that
+    flips the env var mid-process would re-measure the stale basis.
+    In-process A/B therefore passes ``exp2=`` explicitly to the public
+    entry points (``pallas_flash_attention`` / ``pallas_flash_partials``
+    / ``pallas_flash_fused`` / ``pallas_flash_backward``), which both
+    bypasses the env var and keys the jit cache correctly; the env var
+    remains the right knob for per-process A/B (``tools/hw_session.sh``
+    launches ``env RING_ATTN_EXP2=1 python bench.py ...``).  The
+    attention custom_vjp resolves the flag ONCE per call in
+    ``pallas_flash_attention``, so its forward and backward can never
+    disagree on the basis.
+    """
     return os.environ.get("RING_ATTN_EXP2", "0") == "1"
 
 
@@ -581,6 +598,7 @@ def _flash_fwd_call(
     q, k, v, kv_mask, *,
     scale, causal_offset, window_lo, softclamp_value,
     block_q, block_k, band_hint, interpret, fused, carry=None,
+    exp2=None,
 ):
     """Shared forward launcher: one flash sweep over a KV span.
 
@@ -604,9 +622,10 @@ def _flash_fwd_call(
     # (docs/hardware_log.md, round-5 roofline note), so score-path VPU ops
     # are the scarce resource.  Non-power-of-two scales keep the in-kernel
     # multiply: folding those would round q a second time.
-    # RING_ATTN_EXP2=1 additionally moves the whole tile into log2 space
-    # (fold scale*log2e, exponentials become exp2) — see _exp2_default.
-    exp2 = _exp2_default()
+    # exp2 (explicit kw, or RING_ATTN_EXP2=1 when None — trace-time
+    # capture, see _exp2_default) moves the whole tile into log2 space
+    # (fold scale*log2e, exponentials become exp2).
+    exp2 = _exp2_default() if exp2 is None else bool(exp2)
     if exp2:
         q = q * jnp.asarray(scale * LOG2E, q.dtype)
         scale = 1.0
@@ -753,7 +772,7 @@ def _flash_fwd_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=semantics
         ),
         interpret=interpret,
@@ -785,6 +804,7 @@ def pallas_flash_partials(
     band_hint: tuple[int, int, int, int] | None = None,
     carry: FlashPartials | None = None,
     interpret: bool | None = None,
+    exp2: bool | None = None,
 ) -> FlashPartials:
     """One flash sweep over a KV span, returning mergeable partials.
 
@@ -794,13 +814,17 @@ def pallas_flash_partials(
     still engages (see :func:`_normalize_hint`).  ``carry`` continues a
     previous sweep's online softmax in-kernel (ring hops) — equivalent to
     ``merge_partials(carry, <this sweep>)`` without the XLA-side merge
-    traffic.
+    traffic.  ``exp2`` selects log2-space scoring explicitly (None =
+    the ``RING_ATTN_EXP2`` env var, captured at trace time — see
+    :func:`_exp2_default`); the emitted partials are natural-basis either
+    way, so sweeps of different bases merge exactly.
     """
     return _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
         band_hint=band_hint, interpret=interpret, fused=False, carry=carry,
+        exp2=exp2,
     )
 
 
@@ -819,6 +843,7 @@ def pallas_flash_fused(
     band_hint: tuple[int, int, int, int] | None = None,
     carry: FlashPartials | None = None,
     interpret: bool | None = None,
+    exp2: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Single-span forward with normalization fused into the final kernel
     write: returns ``(out in q.dtype, lse f32)`` directly.
@@ -846,6 +871,7 @@ def pallas_flash_fused(
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
         band_hint=band_hint, interpret=interpret, fused=True, carry=carry,
+        exp2=exp2,
     )
 
 
@@ -1128,7 +1154,7 @@ def pallas_flash_decode_q8(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -1443,6 +1469,7 @@ def pallas_flash_backward(
     block_k_dq: int | None = None,
     band_hint: tuple[int, int, int, int] | None = None,
     interpret: bool | None = None,
+    exp2: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-pass flash backward. Returns (dq, dk, dv), all f32, dk/dv with
     ``hk`` heads (GQA group-summed).
@@ -1463,8 +1490,9 @@ def pallas_flash_backward(
     # In exp2 mode (RING_ATTN_EXP2=1) the fold is scale*log2e and lse
     # converts to log2 units once out here, so the in-tile p recompute is
     # a bare exp2; dk then carries a surplus log2e absorbed by a ln2
-    # multiply on its (nk, d) output.
-    exp2 = _exp2_default()
+    # multiply on its (nk, d) output.  Explicit ``exp2=`` overrides the
+    # env var (trace-time capture, see _exp2_default).
+    exp2 = _exp2_default() if exp2 is None else bool(exp2)
     dq_post_scale = 1.0
     dkv_post_scale = 1.0
     if exp2:
@@ -1640,7 +1668,7 @@ def pallas_flash_backward(
             _sds((b * h, nk, d), jnp.float32, q),
             _sds((b * h, nk, d), jnp.float32, q),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=dkv_semantics
         ),
         interpret=interpret,
@@ -1702,7 +1730,7 @@ def pallas_flash_backward(
             scratch_shapes=[pltpu.VMEM((bq2, d), jnp.float32)],
         ),
         out_shape=_sds((b * h, nq, d), jnp.float32, q),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=dq_semantics
         ),
         interpret=interpret,
@@ -1718,17 +1746,18 @@ def pallas_flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _pallas_flash_core(q, k, v, kv_mask, scale, causal_offset, window,
-                       softclamp_value, interpret):
+                       softclamp_value, interpret, exp2):
     out, _ = _pallas_flash_fwd_impl(
-        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value, interpret
+        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value,
+        interpret, exp2
     )
     return out
 
 
 def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
-                           softclamp_value, interpret):
+                           softclamp_value, interpret, exp2):
     window_lo = causal_offset - (window - 1) if window is not None else None
     # fused finalize: the kernel writes normalized q.dtype output + lse, so
     # the f32 (acc, m, l) triple never touches HBM (512 MB saved per call
@@ -1736,7 +1765,7 @@ def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
     out, lse = pallas_flash_fused(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
-        softclamp_value=softclamp_value, interpret=interpret,
+        softclamp_value=softclamp_value, interpret=interpret, exp2=exp2,
     )
     # named residuals: lets a remat policy save (out, lse) so the backward's
     # residual recompute elides this kernel (see parallel/ring.py, same names)
@@ -1746,22 +1775,23 @@ def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
 
 
 def _pallas_flash_core_fwd(q, k, v, kv_mask, scale, causal_offset, window,
-                           softclamp_value, interpret):
+                           softclamp_value, interpret, exp2):
     out, lse = _pallas_flash_fwd_impl(
-        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value, interpret
+        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value,
+        interpret, exp2
     )
     return out, (q, k, v, kv_mask, out, lse)
 
 
 def _pallas_flash_core_bwd(scale, causal_offset, window, softclamp_value,
-                           interpret, res, do):
+                           interpret, exp2, res, do):
     q, k, v, kv_mask, out, lse = res
     window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
     dq, dk, dv = pallas_flash_backward(
         do, q, k, v, lse, delta, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
-        softclamp_value=softclamp_value, interpret=interpret,
+        softclamp_value=softclamp_value, interpret=interpret, exp2=exp2,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
 
@@ -1781,6 +1811,7 @@ def pallas_flash_attention(
     scale: float | None = None,
     head_chunks: int | None = None,
     interpret: bool | None = None,
+    exp2: bool | None = None,
 ) -> jax.Array:
     """Exact flash attention on the Pallas TPU kernel path (GQA-aware).
 
@@ -1806,6 +1837,10 @@ def pallas_flash_attention(
         mask = None
     causal_offset = k.shape[2] - q.shape[2] if causal else None
     interpret = interpret if interpret is not None else _interpret_default()
+    # resolve the log2-space flag ONCE here: the custom_vjp's forward and
+    # backward then share one basis even if the env var flips mid-call,
+    # and an explicit exp2= keys the jit cache (see _exp2_default)
+    exp2 = _exp2_default() if exp2 is None else bool(exp2)
     if head_chunks is not None and head_chunks > 1:
         h, hk = q.shape[1], k.shape[1]
         if h % head_chunks or hk % head_chunks:
@@ -1820,12 +1855,12 @@ def pallas_flash_attention(
                 k[:, i * hk_c:(i + 1) * hk_c],
                 v[:, i * hk_c:(i + 1) * hk_c],
                 mask, scale, causal_offset, window, softclamp_value,
-                interpret,
+                interpret, exp2,
             )
             for i in range(head_chunks)
         ]
         return jnp.concatenate(outs, axis=1)
     return _pallas_flash_core(
         q, k, v, mask, scale, causal_offset, window, softclamp_value,
-        interpret,
+        interpret, exp2,
     )

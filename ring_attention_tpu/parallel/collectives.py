@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import compat
+
 
 def axis_rank(axis_name: str) -> jax.Array:
     """This device's position along a mesh axis (inside shard_map)."""
@@ -40,7 +42,7 @@ def axis_rank(axis_name: str) -> jax.Array:
 
 def axis_world(axis_name: str) -> int:
     """Static size of a mesh axis (inside shard_map)."""
-    return lax.axis_size(axis_name)
+    return compat.axis_size(axis_name)
 
 
 def gather_sizes(size: jax.Array, axis_name: str) -> jax.Array:
@@ -73,7 +75,7 @@ def all_gather_variable(
     if max_size is None:
         max_size = x.shape[axis]
     assert x.shape[axis] == max_size, "pad x to max_size before gathering"
-    world = lax.axis_size(axis_name)
+    world = compat.axis_size(axis_name)
 
     gathered = lax.all_gather(x, axis_name, axis=axis, tiled=True)
     lengths = gather_sizes(length, axis_name)  # (world,)
@@ -107,7 +109,7 @@ def compact_masked(gathered: jax.Array, mask: jax.Array, *, axis: int = 0) -> ja
 def split_by_rank(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
     """Take this rank's equal slice of a replicated array
     (ref ``distributed.py:117-127``)."""
-    world = lax.axis_size(axis_name)
+    world = compat.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     assert x.shape[axis] % world == 0, (
         f"axis {axis} size {x.shape[axis]} must divide over {world} ranks; "

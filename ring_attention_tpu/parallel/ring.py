@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
+from ..utils import compat
 from ..ops.flash import (
     attend_blocks,
     finalize,
@@ -80,7 +81,7 @@ from ..utils.validate import check_attention_args
 
 
 def _ring_perm(axis_name: str, shift: int = 1) -> list[tuple[int, int]]:
-    size = lax.axis_size(axis_name)
+    size = compat.axis_size(axis_name)
     return [(j, (j + shift) % size) for j in range(size)]
 
 
@@ -538,7 +539,7 @@ def _ring_fwd_impl(
     hk = k.shape[1]
     if scale is None:
         scale = d**-0.5
-    ring_size = lax.axis_size(axis_name)
+    ring_size = compat.axis_size(axis_name)
     passes = min(max_ring_passes or ring_size, ring_size)
     rank = lax.axis_index(axis_name)
 
@@ -624,7 +625,7 @@ def _ring_vjp_bwd(
     hk = k.shape[1]
     if scale is None:
         scale = d**-0.5
-    ring_size = lax.axis_size(axis_name)
+    ring_size = compat.axis_size(axis_name)
     passes = min(max_ring_passes or ring_size, ring_size)
     rank = lax.axis_index(axis_name)
 

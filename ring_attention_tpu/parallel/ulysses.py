@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import compat
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import pallas_flash_attention
 from ..utils.validate import check_attention_args
@@ -53,7 +54,7 @@ def ulysses_attention(
     check_attention_args("ulysses_attention", q, k, v, kv_mask, equal_qkv_len=True)
     b, h, n_local, d = q.shape
     hk = k.shape[1]
-    world = lax.axis_size(axis_name)
+    world = compat.axis_size(axis_name)
     assert h % world == 0, f"query heads {h} must divide over {world} devices"
 
     if hk % world:

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import compat
 from ..ops.flash import attend_blocks, finalize, init_carry, _ungroup
 from ..ops.pallas_flash import (
     finalize_partials,
@@ -184,7 +185,7 @@ def zigzag_attention(
     g = h // hk
     if scale is None:
         scale = d**-0.5
-    ring_size = lax.axis_size(axis_name)
+    ring_size = compat.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     chunk = n_local // 2
 

@@ -1,14 +1,25 @@
-from .checkpoint import restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+    CheckpointStructureError,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from .profiling import StepTimer, trace
 from .benchtime import enable_compile_cache, fetch_rtt, timed_chained
-from .train import make_train_step, shard_optimizer_state
+from .train import StepStats, init_step_stats, make_train_step, shard_optimizer_state
 from .validate import check_attention_args, check_model_input, check_tokens_input
 
 __all__ = [
     "make_train_step",
     "shard_optimizer_state",
+    "StepStats",
+    "init_step_stats",
     "restore_checkpoint",
     "save_checkpoint",
+    "CheckpointManager",
+    "CheckpointCorruptError",
+    "CheckpointStructureError",
     "StepTimer",
     "trace",
     "check_attention_args",

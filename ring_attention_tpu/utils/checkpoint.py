@@ -3,15 +3,29 @@
 The reference has **no** checkpoint story — ``state_dict`` is used only to
 clone weights inside its parity tests (SURVEY §5; ref ``assert.py:81``).
 Training at ring-attention sequence lengths without resumability is not
-operable, so this framework ships a thin wrapper over Orbax (the TPU-native
-checkpoint layer): sharded arrays are written/restored per-shard with their
-``NamedSharding`` preserved, so a (data, seq) mesh job resumes in place.
+operable, so this framework ships two layers:
+
+- :func:`save_checkpoint` / :func:`restore_checkpoint` — a thin wrapper
+  over Orbax (the TPU-native checkpoint layer): sharded arrays are
+  written/restored per-shard with their ``NamedSharding`` preserved, so a
+  (data, seq) mesh job resumes in place.
+- :class:`CheckpointManager` — the preemption-safe periodic-save loop
+  around it (part of the resilience layer, see ``docs/resilience.md``):
+  atomic write-then-rename saves, keep-last-N retention, checksum-verified
+  restore that detects a truncated/partial checkpoint (the file a
+  preempted host leaves behind) and falls back to the previous good step,
+  and :meth:`CheckpointManager.resume_or_init` as the one-call resume
+  story for training loops (``examples/train.py``).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any
+import shutil
+import warnings
+from typing import Any, Callable
 
 import jax
 
@@ -65,3 +79,333 @@ def restore_checkpoint(
 
     template = jax.tree.map(to_restore_type, template)
     return _checkpointer().restore(os.fspath(os.path.abspath(path)), template)
+
+
+# ----------------------------------------------------------------------
+# Preemption-safe periodic checkpointing (resilience layer)
+# ----------------------------------------------------------------------
+
+_STEP_PREFIX = "step_"
+_ARRAYS = "arrays.npz"
+_MANIFEST = "manifest.json"
+_FORMAT = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (truncated write,
+    checksum mismatch, unreadable manifest).  Restore treats this as
+    "that step never completed" and falls back to the previous one."""
+
+
+class CheckpointStructureError(RuntimeError):
+    """The saved state's pytree structure does not match the restore
+    template — typically the optimizer or model definition changed between
+    save and restore.  NOT a corruption: falling back to an older step
+    would hit the same mismatch, so this raises immediately with both
+    structures named instead of surfacing as a cryptic tree-map error."""
+
+
+def _state_leaves(state: Any):
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return [np.asarray(jax.device_get(leaf)) for leaf in leaves], treedef
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # not supported on every platform/filesystem; rename still lands
+
+
+class CheckpointManager:
+    """Atomic, retained, checksum-verified step checkpoints in a directory.
+
+    Layout: ``<directory>/step_<8 digits>/{arrays.npz, manifest.json}``.
+    Saves write into a hidden temp directory and ``os.replace`` it into
+    place, so a checkpoint either exists completely or not at all — a
+    preemption mid-write leaves only a temp directory that the next save
+    sweeps away, never a half-readable ``step_*``.  The manifest carries a
+    SHA-256 of the array payload; restore verifies it and silently (one
+    warning) falls back to the newest older step on any integrity failure.
+
+    This manager targets the single-process case (CPU mesh / one-host TPU:
+    every device's shards are addressable).  Multi-host jobs should use
+    :func:`save_checkpoint` / :func:`restore_checkpoint` (Orbax coordinates
+    cross-host writes) — the manager refuses ``jax.process_count() > 1``
+    rather than writing per-host files that look like full checkpoints.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, keep: int = 3):
+        if keep < 1:
+            raise ValueError(f"CheckpointManager: keep must be >= 1, got {keep}")
+        self.directory = os.fspath(os.path.abspath(directory))
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- directory bookkeeping ---------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+
+    def all_steps(self) -> list[int]:
+        """Completed (renamed-into-place) steps, ascending."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _sweep_tmp(self) -> None:
+        """Clean up after a preempted save: delete half-written temp dirs,
+        and RECOVER a ``step_*.old`` backup whose live step vanished (the
+        crash landed between rename-aside and rename-into-place — the
+        backup is a complete, verified checkpoint)."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for name in names:
+            path = os.path.join(self.directory, name)
+            if name.startswith(".tmp-"):
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.endswith(".old"):
+                live = path[: -len(".old")]
+                if os.path.isdir(live):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    try:
+                        os.replace(path, live)
+                    except OSError:
+                        pass
+
+    # -- save ---------------------------------------------------------
+
+    def save(self, step: int, state: Any) -> str:
+        """Write ``state`` (any pytree of arrays) as step ``step``.
+
+        Atomic: the ``step_*`` directory appears only after every byte
+        (including the checksum manifest) is on disk.  Existing data for
+        the same step is replaced.  Retention then deletes all but the
+        newest ``keep`` steps.  Returns the final checkpoint path.
+        """
+        import numpy as np
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "CheckpointManager is single-process; use save_checkpoint "
+                "(Orbax) for multi-host jobs"
+            )
+        self._sweep_tmp()
+        leaves, treedef = _state_leaves(state)
+        final = self._step_dir(step)
+        tmp = os.path.join(
+            self.directory, f".tmp-{_STEP_PREFIX}{step:08d}-{os.getpid()}"
+        )
+        os.makedirs(tmp)
+        try:
+            npz_path = os.path.join(tmp, _ARRAYS)
+            with open(npz_path, "wb") as f:
+                np.savez(f, **{f"leaf_{i:05d}": a for i, a in enumerate(leaves)})
+                f.flush()
+                os.fsync(f.fileno())
+            manifest = {
+                "format": _FORMAT,
+                "step": int(step),
+                "leaf_count": len(leaves),
+                "treedef": str(treedef),
+                "shapes": [list(a.shape) for a in leaves],
+                "dtypes": [str(a.dtype) for a in leaves],
+                "sha256": _sha256(npz_path),
+            }
+            man_path = os.path.join(tmp, _MANIFEST)
+            with open(man_path, "w") as f:
+                json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            # re-save of an existing step stays atomic: the old intact
+            # checkpoint is renamed aside (not deleted) until the new one
+            # is in place, so no preemption point loses both
+            backup = None
+            if os.path.isdir(final):
+                backup = final + ".old"
+                shutil.rmtree(backup, ignore_errors=True)
+                os.replace(final, backup)
+            os.replace(tmp, final)
+            _fsync_dir(self.directory)
+            if backup is not None:
+                shutil.rmtree(backup, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._prune()
+        return final
+
+    def _prune(self) -> None:
+        for step in self.all_steps()[: -self.keep]:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    # -- restore ------------------------------------------------------
+
+    def _load_step(self, step: int, template: Any) -> Any:
+        import numpy as np
+
+        path = self._step_dir(step)
+        man_path = os.path.join(path, _MANIFEST)
+        npz_path = os.path.join(path, _ARRAYS)
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"step {step}: unreadable manifest ({e})"
+            ) from e
+        if manifest.get("format") != _FORMAT:
+            raise CheckpointCorruptError(
+                f"step {step}: unknown checkpoint format "
+                f"{manifest.get('format')!r}"
+            )
+        try:
+            digest = _sha256(npz_path)
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"step {step}: unreadable array payload ({e})"
+            ) from e
+        if digest != manifest.get("sha256"):
+            raise CheckpointCorruptError(
+                f"step {step}: array payload checksum mismatch "
+                f"(truncated or partially-written checkpoint)"
+            )
+
+        t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
+        if manifest.get("treedef") != str(t_treedef) or manifest.get(
+            "leaf_count"
+        ) != len(t_leaves):
+            raise CheckpointStructureError(
+                f"step {step}: saved state structure does not match the "
+                f"restore template (did the model or optimizer definition "
+                f"change?).\n  saved:    {manifest.get('leaf_count')} leaves, "
+                f"{manifest.get('treedef')}\n  template: {len(t_leaves)} "
+                f"leaves, {t_treedef}"
+            )
+        try:
+            with np.load(npz_path) as z:
+                loaded = [z[f"leaf_{i:05d}"] for i in range(len(t_leaves))]
+        except Exception as e:  # zipfile/np raise several types on truncation
+            raise CheckpointCorruptError(
+                f"step {step}: failed to read arrays ({e})"
+            ) from e
+
+        out = []
+        for i, (arr, ref) in enumerate(zip(loaded, t_leaves)):
+            if isinstance(ref, jax.Array) and tuple(arr.shape) != tuple(
+                ref.shape
+            ):
+                raise CheckpointStructureError(
+                    f"step {step}: leaf {i} shape {tuple(arr.shape)} != "
+                    f"template {tuple(ref.shape)}"
+                )
+            if isinstance(ref, jax.Array):
+                if getattr(ref, "_committed", True):
+                    # committed template (e.g. device_put / sharding-
+                    # constrained onto a mesh): restore to the same sharding
+                    out.append(
+                        jax.device_put(arr.astype(ref.dtype), ref.sharding)
+                    )
+                else:
+                    # uncommitted template (plain computation output, e.g.
+                    # model.init): keep it uncommitted so a later jit may
+                    # co-locate it with mesh-sharded arguments
+                    import jax.numpy as jnp
+
+                    out.append(jnp.asarray(arr.astype(ref.dtype)))
+            else:
+                out.append(arr)
+        return jax.tree_util.tree_unflatten(t_treedef, out)
+
+    def restore(
+        self, template: Any, *, step: int | None = None
+    ) -> tuple[Any, int] | None:
+        """Restore the newest intact checkpoint (or exactly ``step``).
+
+        ``template`` supplies structure/shapes/dtypes/shardings — typically
+        the freshly-initialized state.  Returns ``(state, step)``, or
+        ``None`` when the directory holds no checkpoint at all (missing,
+        empty, or only corrupt steps — each corrupt step warns once and is
+        skipped).  A structure mismatch raises
+        :class:`CheckpointStructureError` instead of falling back: older
+        steps share the saved structure, so fallback would mask a real
+        code/checkpoint incompatibility.
+        """
+        self._sweep_tmp()  # recover an orphaned .old backup before listing
+        if step is not None and not os.path.isdir(self._step_dir(step)):
+            # absent is not corrupt: an explicitly-requested step that was
+            # never written (or already pruned) must not warn "corrupt"
+            # and pretend a fallback happened
+            raise FileNotFoundError(
+                f"CheckpointManager: no checkpoint for step {step} in "
+                f"{self.directory} (existing steps: {self.all_steps()})"
+            )
+        candidates = [step] if step is not None else list(
+            reversed(self.all_steps())
+        )
+        for s in candidates:
+            try:
+                return self._load_step(s, template), s
+            except CheckpointCorruptError as e:
+                warnings.warn(
+                    f"CheckpointManager: skipping corrupt checkpoint "
+                    f"({e}); falling back to the previous step",
+                    stacklevel=2,
+                )
+                continue
+        return None
+
+    def resume_or_init(
+        self, init_fn: Callable[[], Any]
+    ) -> tuple[Any, int]:
+        """The one-call resume story for a training loop.
+
+        ``init_fn()`` builds the fresh state (also used as the restore
+        template).  Returns ``(state, start_step)``: the restored state
+        with the step AFTER the checkpointed one, or the fresh state with
+        step 0 when nothing (intact) is on disk::
+
+            mgr = CheckpointManager(args.ckpt_dir, keep=3)
+            state, start = mgr.resume_or_init(make_initial_state)
+            for step in range(start, args.steps):
+                state = train(state)
+                if step % save_every == 0:
+                    mgr.save(step, state)
+        """
+        state = init_fn()
+        restored = self.restore(state)
+        if restored is None:
+            return state, 0
+        state, step = restored
+        return state, step + 1

@@ -1,0 +1,94 @@
+"""JAX version compatibility shims (part of the resilience layer).
+
+The kernels target current JAX (``jax.typeof`` varying-axes metadata,
+top-level ``jax.shard_map`` with ``check_vma``), but CI and dev boxes can
+run older releases where those APIs don't exist yet — and a framework
+whose import crashes on the CPU-only box that would have caught a bug is
+not resilient.  Each shim degrades to the semantically-equivalent older
+API; where the newer API only adds metadata that old JAX cannot represent
+(vma), the fallback is the identity, which is exactly what old JAX's
+``shard_map`` assumes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def typeof(x: Any):
+    """``jax.typeof`` (new) or the abstract value (old) — both expose
+    shape/dtype; only the new one carries ``vma``, and every caller here
+    reads ``vma`` via ``getattr(..., frozenset())``."""
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    return jax.core.get_aval(x)
+
+
+def pcast(x: Any, axes, to: str = "varying"):
+    """``lax.pcast`` when it exists; identity otherwise.
+
+    Callers only reach this with non-empty ``axes`` when :func:`typeof`
+    reported varying-axes metadata — which old JAX never does, so the
+    identity fallback is unreachable there by construction (kept total
+    anyway: resilience code must not be the thing that crashes)."""
+    from jax import lax
+
+    fn = getattr(lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` (new, ``check_vma``) or
+    ``jax.experimental.shard_map.shard_map`` (old, ``check_rep``).
+
+    The two kwargs gate the same per-output replication/varying checker
+    across the rename.  On old JAX the checker is force-disabled: its
+    replication-rule table predates primitives this codebase relies on
+    (``checkpoint_name`` residuals raise ``NotImplementedError: No
+    replication rule for name``), and a checker that crashes working
+    programs is strictly worse than no checker — new-JAX CI keeps the
+    real ``check_vma`` coverage.
+    """
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as old_shard_map
+
+    return old_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (new name) or ``pltpu.TPUCompilerParams``
+    (old name) — same dataclass across the rename; every field this repo
+    passes (``dimension_semantics``) exists in both."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (new) or the bound axis frame's size (old).
+
+    Both return a static Python int inside ``shard_map``, so callers can
+    keep using it for loop bounds and shape arithmetic."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)

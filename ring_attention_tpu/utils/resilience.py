@@ -1,0 +1,412 @@
+"""Resilience layer: retries, fault injection, and kernel degradation.
+
+Ring attention's value is multi-hour runs over million-token contexts on
+many chips — exactly the regime where a single NaN step, preempted host,
+or wedged device kills hours of work.  This repo's own hardware log
+records three consecutive zero-window bench rounds (>=44h of TPU-tunnel
+wedge, docs/hardware_log.md rounds 3-5) with no retry machinery anywhere
+in the tree.  This module turns those lessons into framework code, in
+three pieces used across ``utils/train.py`` (guarded step),
+``utils/checkpoint.py`` (preemption-safe saves), ``ops``/``models``
+(kernel fallback), ``bench.py``, and ``tools/``:
+
+- :func:`with_retries` — timeout + exponential-backoff wrapper for
+  callables that can hang (device probes through a wedged tunnel) or
+  fail transiently (relay 500s).
+- :class:`FaultInjector` / :func:`inject` — the test harness's hook for
+  forcing the failures the resilience machinery exists to survive
+  (NaN grads, truncated checkpoints, Pallas compile errors, hung
+  probes), so every degradation path is exercised on the CPU mesh.
+- :class:`DegradationRecord` + :func:`pallas_available` /
+  :func:`resolve_attention_impl` — graceful kernel degradation:
+  ``impl="auto"`` callers get the Pallas path when it compiles and a
+  one-shot-warned, queryable fallback to the XLA path when it doesn't.
+
+Everything here is host-side Python (no jax transforms are applied to
+this module's code), so it composes with jit-compiled callers by running
+at trace/dispatch time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Sequence
+
+
+# ----------------------------------------------------------------------
+# Fault injection
+# ----------------------------------------------------------------------
+
+
+class InjectedFault(RuntimeError):
+    """Raised by :meth:`FaultInjector.check` at an armed injection point."""
+
+
+class FaultInjector:
+    """Process-global registry of armed faults.
+
+    Production code calls :meth:`check`/:meth:`armed` at its injection
+    points; tests arm faults with :func:`inject` (a context manager, so a
+    failing assertion can never leave a fault armed for the next test).
+    Armed faults may carry a payload (:meth:`value`) — e.g. the step index
+    at which to poison gradients.
+    """
+
+    def __init__(self) -> None:
+        self._faults: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def arm(self, name: str, value: Any = True) -> None:
+        with self._lock:
+            self._faults[name] = value
+
+    def disarm(self, name: str) -> None:
+        with self._lock:
+            self._faults.pop(name, None)
+
+    def armed(self, name: str) -> bool:
+        with self._lock:
+            return name in self._faults
+
+    def value(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._faults.get(name, default)
+
+    def check(self, name: str) -> None:
+        """Raise :class:`InjectedFault` when ``name`` is armed (no-op
+        otherwise) — the one-line injection point for failure paths."""
+        if self.armed(name):
+            raise InjectedFault(f"injected fault: {name}")
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+
+_INJECTOR = FaultInjector()
+
+
+def get_injector() -> FaultInjector:
+    return _INJECTOR
+
+
+@contextmanager
+def inject(name: str, value: Any = True) -> Iterator[FaultInjector]:
+    """Arm fault ``name`` for the duration of the block (always disarmed
+    on exit, even when the block raises).
+
+    Exit drains pending JAX runtime effects first: jitted computations
+    dispatch asynchronously, so a ``pure_callback`` injection point
+    (:func:`nan_tap`) inside a step launched in the block could otherwise
+    execute AFTER the block disarmed the fault — the injection would
+    silently miss.  ``jax.effects_barrier()`` guarantees every callback
+    from inside the block observed the armed state.
+    """
+    _INJECTOR.arm(name, value)
+    try:
+        yield _INJECTOR
+    finally:
+        try:
+            import jax
+
+            jax.effects_barrier()
+        except Exception:  # noqa: BLE001 — jax absent/old: nothing to drain
+            pass
+        _INJECTOR.disarm(name)
+
+
+# ----------------------------------------------------------------------
+# Retry / timeout / backoff
+# ----------------------------------------------------------------------
+
+
+class RetryTimeout(TimeoutError):
+    """A single attempt exceeded its timeout budget."""
+
+
+class RetryError(RuntimeError):
+    """All attempts failed; ``last`` holds the final attempt's exception."""
+
+    def __init__(self, message: str, last: BaseException | None = None):
+        super().__init__(message)
+        self.last = last
+
+
+def _call_with_timeout(fn: Callable[[], Any], timeout: float) -> Any:
+    """Run ``fn()`` with a hard wall-clock budget.
+
+    The callable runs in a daemon thread; on timeout the thread is
+    abandoned (Python offers no safe cross-thread kill) and
+    :class:`RetryTimeout` is raised.  Callables that own external
+    resources should therefore enforce their own inner timeout too
+    (e.g. ``subprocess.run(timeout=...)`` kills the child) — this wrapper
+    is the backstop for the observed wedge mode where even the probe's
+    bookkeeping hangs.
+    """
+    result: list[Any] = []
+    error: list[BaseException] = []
+
+    def run() -> None:
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            error.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise RetryTimeout(f"attempt still running after {timeout:.1f}s")
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    *,
+    timeout: float | None = None,
+    backoff: float = 1.0,
+    max_attempts: int = 3,
+    retry_on: tuple[type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Callable[[int, BaseException], None] | None = None,
+) -> Any:
+    """Call ``fn()`` with per-attempt ``timeout`` and exponential backoff.
+
+    Attempt ``i`` (0-based) that fails with ``retry_on`` (or times out)
+    is followed by ``sleep(backoff * 2**i)`` before the next attempt;
+    after ``max_attempts`` failures a :class:`RetryError` carrying the
+    last exception is raised.  ``sleep`` and ``on_retry`` are injectable
+    for tests (and for callers that want to log each retry).
+
+    ``timeout=None`` disables the wall-clock guard (pure retry/backoff);
+    otherwise each attempt gets its own ``timeout`` seconds — see
+    :func:`_call_with_timeout` for the abandonment caveat on hung
+    callables.
+    """
+    if max_attempts < 1:
+        raise ValueError(f"with_retries: max_attempts must be >= 1, got {max_attempts}")
+    if backoff < 0:
+        raise ValueError(f"with_retries: backoff must be >= 0, got {backoff}")
+    last: BaseException | None = None
+    for attempt in range(max_attempts):
+        try:
+            if timeout is None:
+                return fn()
+            return _call_with_timeout(fn, timeout)
+        except (RetryTimeout, *retry_on) as e:  # noqa: B030
+            last = e
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if attempt + 1 < max_attempts:
+                sleep(backoff * (2**attempt))
+    raise RetryError(
+        f"with_retries: all {max_attempts} attempts failed "
+        f"(last: {type(last).__name__}: {last})",
+        last,
+    )
+
+
+# ----------------------------------------------------------------------
+# Graceful kernel degradation
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class DegradationEvent:
+    component: str
+    reason: str
+    time: float = field(default_factory=time.time)
+
+
+class DegradationRecord:
+    """Queryable record of components that fell back to a degraded path.
+
+    The first failure of a component emits ONE ``UserWarning`` (multi-hour
+    runs must not drown their logs in per-step warnings); every failure is
+    appended to :meth:`events` so operators and tests can ask exactly what
+    degraded and why.
+    """
+
+    def __init__(self) -> None:
+        self._events: list[DegradationEvent] = []
+        self._degraded: set[str] = set()
+        self._lock = threading.Lock()
+
+    def record(self, component: str, reason: BaseException | str) -> None:
+        text = f"{type(reason).__name__}: {reason}" if isinstance(
+            reason, BaseException
+        ) else str(reason)
+        with self._lock:
+            first = component not in self._degraded
+            self._degraded.add(component)
+            self._events.append(DegradationEvent(component, text))
+        if first:
+            warnings.warn(
+                f"resilience: {component} degraded, falling back "
+                f"({text}); further occurrences are recorded silently — "
+                f"see ring_attention_tpu.utils.resilience.degradation.events()",
+                stacklevel=3,
+            )
+
+    def is_degraded(self, component: str) -> bool:
+        with self._lock:
+            return component in self._degraded
+
+    def events(self) -> Sequence[DegradationEvent]:
+        with self._lock:
+            return tuple(self._events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._degraded.clear()
+
+
+degradation = DegradationRecord()
+
+# component name shared by the probe, the ops dispatcher, and the models
+PALLAS_COMPONENT = "pallas_flash"
+# fault name the injection harness arms to force the Pallas path to fail
+PALLAS_FAULT = "pallas_fail"
+
+_pallas_probe: bool | None = None
+_pallas_probe_lock = threading.Lock()
+
+
+class _PallasNotApplicable(Exception):
+    """The backend has no real Pallas path (non-TPU): ``auto`` resolves to
+    XLA *silently* — nothing degraded, the fast path never existed here.
+    Interpret-mode Pallas would "work" on CPU but is pure-Python slow;
+    choosing it over the XLA flash path would be a pessimization, not a
+    fallback."""
+
+
+def _probe_pallas() -> None:
+    """Compile-and-run a minimal real (non-interpret) Pallas flash call.
+
+    Raises whatever the Pallas path raises on this backend — lowering
+    errors, Mosaic rejections, missing plugin — which is exactly the
+    signal ``impl="auto"`` needs BEFORE a caller's outer jit bakes the
+    kernel choice in.  Raises :class:`_PallasNotApplicable` on non-TPU
+    backends (see its docstring); the injected :data:`PALLAS_FAULT` is
+    checked first so CI can exercise the degradation path anywhere.
+    """
+    get_injector().check(PALLAS_FAULT)
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        raise _PallasNotApplicable(
+            f"backend {jax.devices()[0].platform!r} has no Mosaic path"
+        )
+    import jax.numpy as jnp
+
+    from ..ops.pallas_flash import pallas_flash_attention
+
+    q = jnp.zeros((1, 1, 128, 64), jnp.float32)
+    out = pallas_flash_attention(q, q, q, causal=True, interpret=False)
+    jax.block_until_ready(out)
+
+
+def pallas_available(*, refresh: bool = False) -> bool:
+    """True when the real Pallas kernel path works on this backend.
+
+    The probe runs once per process (cached).  A non-TPU backend returns
+    False silently (not a degradation — see :class:`_PallasNotApplicable`);
+    a TPU whose kernels fail records a :data:`PALLAS_COMPONENT`
+    degradation with a one-shot warning.  Pass ``refresh=True`` to
+    re-probe (tests; or after an operator fixes the environment
+    mid-process).
+    """
+    global _pallas_probe
+    with _pallas_probe_lock:
+        if _pallas_probe is not None and not refresh:
+            return _pallas_probe
+        try:
+            _probe_pallas()
+            _pallas_probe = True
+        except _PallasNotApplicable:
+            _pallas_probe = False
+        except Exception as e:  # noqa: BLE001 — any failure means degrade
+            degradation.record(PALLAS_COMPONENT, e)
+            _pallas_probe = False
+        return _pallas_probe
+
+
+def resolve_attention_impl(impl: str | None) -> str:
+    """Resolve a requested attention impl to a concrete one.
+
+    ``"xla"``/``None`` and ``"pallas"`` pass through (an explicit request
+    must fail loudly, never silently degrade); ``"auto"`` returns
+    ``"pallas"`` when the probe passes and the component has not been
+    marked degraded, else ``"xla"``.  Resolution happens at trace time,
+    so an outer ``jax.jit`` compiles exactly one path.
+    """
+    if impl in (None, "xla"):
+        return "xla"
+    if impl == "pallas":
+        return "pallas"
+    if impl == "auto":
+        if degradation.is_degraded(PALLAS_COMPONENT):
+            return "xla"
+        return "pallas" if pallas_available() else "xla"
+    raise ValueError(
+        f"resolve_attention_impl: impl must be 'auto', 'pallas', 'xla' or "
+        f"None, got {impl!r}"
+    )
+
+
+def reset(*, probe: bool = True) -> None:
+    """Test-harness hook: clear armed faults, degradation state, and
+    (optionally) the cached Pallas probe result."""
+    global _pallas_probe
+    _INJECTOR.clear()
+    degradation.reset()
+    if probe:
+        with _pallas_probe_lock:
+            _pallas_probe = None
+
+
+# ----------------------------------------------------------------------
+# NaN-grad injection tap (jit-compatible)
+# ----------------------------------------------------------------------
+
+
+def nan_tap(x, name: str = "nan_loss"):
+    """Multiply ``x`` by NaN when fault ``name`` is armed — under jit.
+
+    The armed/disarmed decision is fetched at RUN time through
+    ``jax.pure_callback`` (a trace-time Python check would be baked into
+    the compiled step and could never fire "at step k"), so a test can run
+    a compiled train step normally for k steps, arm the fault for exactly
+    one step, and assert the guarded step skipped it.  Production code
+    pays one scalar host callback only if it opts in by wrapping its loss
+    with :func:`faulty_loss`.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def factor() -> np.ndarray:
+        return np.float32(np.nan if _INJECTOR.armed(name) else 1.0)
+
+    f = jax.pure_callback(
+        factor, jax.ShapeDtypeStruct((), jnp.float32), vmap_method="broadcast_all"
+    )
+    return x * f.astype(x.dtype)
+
+
+def faulty_loss(loss_fn: Callable[..., Any], name: str = "nan_loss"):
+    """Wrap ``loss_fn`` with a :func:`nan_tap` on its scalar output, so the
+    fault-injection harness can poison the loss (and therefore every
+    gradient) of an arbitrary training step."""
+
+    def wrapped(*args, **kwargs):
+        return nan_tap(loss_fn(*args, **kwargs), name)
+
+    return wrapped

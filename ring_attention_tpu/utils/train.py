@@ -20,7 +20,7 @@ inside ``jit``/``shard_map`` like everything else here:
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -29,11 +29,33 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+class StepStats(NamedTuple):
+    """Per-step resilience telemetry carried through the guarded step.
+
+    ``step_ok`` — whether THIS step's update was applied (False: non-finite
+    loss or gradients were detected and the optimizer update was skipped).
+    ``skipped`` — running count of skipped steps since
+    :func:`init_step_stats`; a handful per multi-hour run is survivable
+    noise, a growing streak means the run has diverged and should stop.
+    """
+
+    step_ok: jax.Array  # bool scalar
+    skipped: jax.Array  # int32 scalar
+
+
+def init_step_stats() -> StepStats:
+    return StepStats(
+        step_ok=jnp.asarray(True), skipped=jnp.asarray(0, jnp.int32)
+    )
+
+
 def make_train_step(
     loss_fn: Callable[..., jax.Array],
     optimizer: Any,
     *,
     accum_steps: int = 1,
+    skip_nonfinite: bool = False,
+    clip_grad_norm: float | None = None,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
@@ -47,12 +69,33 @@ def make_train_step(
     The returned step is jit-compatible and mesh-agnostic: microbatching
     slices the leading (batch) axis only, so data/sequence shardings on
     the non-leading axes pass through untouched.
+
+    Resilience options (``utils/resilience.py`` is the companion test
+    harness; see ``docs/resilience.md``):
+
+    - ``clip_grad_norm`` — clip the (full-batch) gradient to this global
+      L2 norm before the update, the standard guard against loss spikes.
+    - ``skip_nonfinite=True`` — the guarded step: when the loss or any
+      gradient is non-finite the optimizer update is SKIPPED inside the
+      jitted step (params and optimizer state pass through bit-identical)
+      instead of corrupting the parameters; one poisoned batch then costs
+      one step, not the run.  The step signature changes to
+      ``step(params, opt_state, stats, *batch) ->
+      (params, opt_state, stats, loss)`` where ``stats`` is a
+      :class:`StepStats` carry seeded by :func:`init_step_stats` —
+      ``stats.step_ok`` reports this step, ``stats.skipped`` counts all
+      skips.  The returned loss is NOT masked on a skipped step, so logs
+      show the offending value.
     """
     if accum_steps < 1:
         raise ValueError(f"make_train_step: accum_steps must be >= 1, got {accum_steps}")
+    if clip_grad_norm is not None and clip_grad_norm <= 0:
+        raise ValueError(
+            f"make_train_step: clip_grad_norm must be > 0, got {clip_grad_norm}"
+        )
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def step(params, opt_state, *batch):
+    def compute_update(params, opt_state, *batch):
         if accum_steps == 1:
             loss, grads = grad_fn(params, *batch)
         else:
@@ -87,10 +130,55 @@ def make_train_step(
             )
             loss = loss_sum * inv
 
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        if clip_grad_norm is not None:
+            gnorm = optax.global_norm(grads)
+            clip = jnp.minimum(
+                1.0, clip_grad_norm / jnp.maximum(gnorm, 1e-12)
+            )
+            grads = jax.tree.map(
+                lambda g: (g * clip).astype(g.dtype), grads
+            )
 
-    return step
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss, grads
+
+    if not skip_nonfinite:
+
+        def step(params, opt_state, *batch):
+            new_params, new_opt_state, loss, _ = compute_update(
+                params, opt_state, *batch
+            )
+            return new_params, new_opt_state, loss
+
+        return step
+
+    def guarded_step(params, opt_state, stats: StepStats, *batch):
+        new_params, new_opt_state, loss, grads = compute_update(
+            params, opt_state, *batch
+        )
+        # one scalar covers every gradient leaf: any NaN/inf propagates
+        # into the global norm (and clipping keeps non-finite values
+        # non-finite, so the check composes with clip_grad_norm)
+        ok = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+
+        def keep_old(new, old):
+            return jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new, old
+            )
+
+        # jnp.where with the old value on the skip branch is bit-identical
+        # (no arithmetic touches the kept params) — the property the
+        # fault-injection suite asserts
+        params = keep_old(new_params, params)
+        opt_state = keep_old(new_opt_state, opt_state)
+        stats = StepStats(
+            step_ok=ok,
+            skipped=stats.skipped + jnp.where(ok, 0, 1).astype(jnp.int32),
+        )
+        return params, opt_state, stats, loss
+
+    return guarded_step
 
 
 def shard_optimizer_state(

@@ -79,3 +79,44 @@ def test_generate_example_sampled_q8():
         "--temperature", "0.8", "--top-k", "50", "--q8-cache",
     )
     assert "sampled 4 tokens" in out, out[-1500:]
+
+
+@pytest.mark.slow
+def test_train_example_kill_and_resume(tmp_path):
+    """The resilience acceptance check: a run killed mid-way and restarted
+    with the same command resumes from the last good checkpoint and ends
+    at the same loss as an uninterrupted run of the same length."""
+    common = [
+        "train.py", "--fake-devices", "2", "--steps", "6",
+        "--seq-len", "64", "--dim", "32", "--batch", "2",
+        "--ckpt-every", "1",
+    ]
+
+    def final_loss(out: str) -> float:
+        losses = [
+            float(line.split("loss")[1].split()[0])
+            for line in out.splitlines() if "loss" in line
+        ]
+        assert losses, out[-1500:]
+        return losses[-1]
+
+    ref = final_loss(_run_example(*common))
+
+    # the "kill": an identical run stopped after 3 steps (checkpointing
+    # every step), then the full-length command rerun on the same dir
+    ckpt = str(tmp_path / "ckpts")
+    _run_example(*common[:4], "3", *common[5:], "--ckpt-dir", ckpt)
+    out = _run_example(*common, "--ckpt-dir", ckpt)
+    assert "resumed from checkpoint (continuing at step 3)" in out, out[-1500:]
+    resumed = final_loss(out)
+    assert abs(resumed - ref) < 1e-4, (ref, resumed)
+
+
+@pytest.mark.slow
+def test_train_example_guarded_flags():
+    out = _run_example(
+        "train.py", "--fake-devices", "2", "--steps", "3",
+        "--seq-len", "64", "--dim", "32", "--batch", "2",
+        "--skip-nonfinite", "--clip-grad-norm", "1.0",
+    )
+    assert "loss" in out

@@ -637,9 +637,25 @@ def test_exp2_log2_space_parity(rng, monkeypatch):
     monkeypatch.setenv("RING_ATTN_EXP2", "1")
     l2 = pallas_flash_partials(q, k, v, scale=32**-0.5, causal_offset=0,
                                interpret=True)
-    np.testing.assert_allclose(l2.m, nat.m, atol=2e-5)
-    np.testing.assert_allclose(l2.l, nat.l, atol=2e-5)
-    np.testing.assert_allclose(l2.acc, nat.acc, atol=2e-5)
+    # rtol covers rows whose l (a sum of up to n exponentials) is large:
+    # the bases legitimately differ by ~1 ulp per accumulation step
+    np.testing.assert_allclose(l2.m, nat.m, atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(l2.l, nat.l, atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(l2.acc, nat.acc, atol=2e-5, rtol=1e-5)
+
+    # the explicit keyword (ADVICE.md: the env var is captured at trace
+    # time, so in-process A/B passes exp2= instead) matches the env path
+    monkeypatch.setenv("RING_ATTN_EXP2", "0")
+    l2kw = pallas_flash_partials(q, k, v, scale=32**-0.5, causal_offset=0,
+                                 interpret=True, exp2=True)
+    np.testing.assert_allclose(l2kw.m, l2.m, atol=0)
+    np.testing.assert_allclose(l2kw.l, l2.l, atol=0)
+    np.testing.assert_allclose(l2kw.acc, l2.acc, atol=0)
+    out_kw = pallas_flash_attention(
+        q, k, v, mask, causal=True, softclamp_value=15.0, interpret=True,
+        exp2=True,
+    )
+    np.testing.assert_allclose(out_kw, ref, atol=2e-5)
 
 
 def test_exp2_carry_resume_parity(rng, monkeypatch):

@@ -1,0 +1,162 @@
+"""Static + behavioral smoke tests over ``tools/*.sh``.
+
+The round-5 advisor found a stale-lock takeover race in
+``tpu_window_watch.sh`` that no test could have caught — shell has no
+import-time syntax check, so a broken watcher is only discovered when a
+scarce TPU window opens.  This module gives the shell tooling a fast CI
+tier: ``bash -n`` parse checks on every script, shellcheck when the host
+has it, and a real two-contender exercise of the watcher's atomic lock
+protocol (temp-dir + rename acquisition; pid-dead + min-age staleness).
+"""
+
+import glob
+import os
+import re
+import shutil
+import subprocess
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = sorted(glob.glob(os.path.join(REPO, "tools", "*.sh")))
+WATCHER = os.path.join(REPO, "tools", "tpu_window_watch.sh")
+
+
+def test_tools_exist():
+    assert TOOLS, "tools/*.sh vanished — update this suite"
+
+
+@pytest.mark.parametrize(
+    "script", TOOLS, ids=[os.path.basename(t) for t in TOOLS]
+)
+def test_bash_syntax(script):
+    proc = subprocess.run(
+        ["bash", "-n", script], capture_output=True, text=True, timeout=60
+    )
+    assert proc.returncode == 0, f"bash -n {script}: {proc.stderr}"
+
+
+@pytest.mark.parametrize(
+    "script", TOOLS, ids=[os.path.basename(t) for t in TOOLS]
+)
+def test_shellcheck_if_available(script):
+    if shutil.which("shellcheck") is None:
+        pytest.skip("shellcheck not installed on this host")
+    proc = subprocess.run(
+        # severity=error: catch real breakage without churning on style
+        ["shellcheck", "--severity=error", script],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"shellcheck {script}:\n{proc.stdout}"
+
+
+# ----------------------------------------------------------------------
+# Watcher lock protocol (the advisor's race, exercised for real)
+# ----------------------------------------------------------------------
+
+def _extract_acquire_lock() -> str:
+    """Pull ``acquire_lock()`` out of the shipped watcher script, so the
+    behavioral tests below exercise the REAL code — an edit to the
+    script's locking (e.g. moving the pid write after the rename,
+    reintroducing the empty-pid race) fails these tests, not a pasted
+    copy of what the function used to be."""
+    src = open(WATCHER).read()
+    m = re.search(r"^acquire_lock\(\) \{\n.*?\n\}\n", src, re.S | re.M)
+    assert m, "acquire_lock() not found in tpu_window_watch.sh"
+    return m.group(0)
+
+
+_LOCK_LIB = _extract_acquire_lock()
+
+
+def _run_lock_snippet(body: str, lock: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["bash", "-c", f'LOCK="{lock}"\n{_LOCK_LIB}\n{body}'],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_watcher_script_uses_atomic_acquisition():
+    """Regression pin: the watcher must keep the temp-dir + rename pattern
+    (a bare ``mkdir $LOCK`` followed by a later pid write reintroduces the
+    empty-pid takeover window)."""
+    src = open(os.path.join(REPO, "tools", "tpu_window_watch.sh")).read()
+    assert 'mv -T "$tmp" "$LOCK"' in src
+    assert "MIN_LOCK_AGE" in src
+    # the pid is written into the temp dir BEFORE the rename
+    assert src.index('echo $$ > "$tmp/pid"') < src.index('mv -T "$tmp" "$LOCK"')
+
+
+def test_lock_acquire_is_exclusive(tmp_path):
+    lock = os.path.join(str(tmp_path), "watch.lock")
+    first = _run_lock_snippet("acquire_lock && echo WON", lock)
+    assert "WON" in first.stdout
+    assert os.path.exists(os.path.join(lock, "pid"))
+    second = _run_lock_snippet(
+        "acquire_lock && echo WON || echo BLOCKED", lock
+    )
+    assert "BLOCKED" in second.stdout
+
+
+def test_lock_held_lock_always_contains_pid(tmp_path):
+    """The race's precondition — a held lock with no pid file — can no
+    longer exist: N concurrent acquirers leave exactly one winner and the
+    lock contains a pid from the instant it exists."""
+    lock = os.path.join(str(tmp_path), "watch.lock")
+    procs = [
+        subprocess.Popen(
+            ["bash", "-c",
+             f'LOCK="{lock}"\n{_LOCK_LIB}\n'
+             "acquire_lock && echo WON || echo LOST"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        for _ in range(8)
+    ]
+    outcomes = [p.communicate(timeout=60)[0].strip() for p in procs]
+    assert outcomes.count("WON") == 1, outcomes
+    with open(os.path.join(lock, "pid")) as f:
+        assert f.read().strip().isdigit()
+
+
+def test_stale_lock_rules(tmp_path):
+    """Takeover requires pid-file-present AND pid-dead AND min age — the
+    three-way rule from ADVICE.md, checked via the watcher's own logic."""
+    lock = os.path.join(str(tmp_path), "watch.lock")
+
+    def staleness_check(min_age: int) -> str:
+        # mirrors the watcher's takeover decision block
+        body = f"""
+        MIN_LOCK_AGE={min_age}
+        oldpid=$(cat "$LOCK/pid" 2>/dev/null)
+        lock_mtime=$(stat -c %Y "$LOCK" 2>/dev/null || echo 0)
+        lock_age=$(( $(date +%s) - lock_mtime ))
+        if [ -n "$oldpid" ] && kill -0 "$oldpid" 2>/dev/null; then
+          echo ALIVE
+        elif [ -z "$oldpid" ] || [ "$lock_age" -lt "$MIN_LOCK_AGE" ]; then
+          echo INDETERMINATE
+        else
+          echo STALE
+        fi
+        """
+        return _run_lock_snippet(textwrap.dedent(body), lock).stdout.strip()
+
+    # live holder -> never stale
+    os.makedirs(lock)
+    with open(os.path.join(lock, "pid"), "w") as f:
+        f.write(str(os.getpid()))
+    assert staleness_check(0) == "ALIVE"
+
+    # dead pid but young lock -> indeterminate (no takeover)
+    with open(os.path.join(lock, "pid"), "w") as f:
+        f.write("999999999")
+    assert staleness_check(3600) == "INDETERMINATE"
+
+    # dead pid + old lock -> stale (takeover allowed)
+    old = 1_000_000_000  # year 2001
+    os.utime(lock, (old, old))
+    assert staleness_check(60) == "STALE"
+
+    # missing pid file -> indeterminate even when old
+    os.remove(os.path.join(lock, "pid"))
+    assert staleness_check(60) == "INDETERMINATE"

@@ -267,7 +267,7 @@ def test_variable_batch_gather_roundtrip(rng):
     exactly the unpadded examples — the mask is what example_mask consumes."""
     from functools import partial
 
-    from jax import shard_map
+    from ring_attention_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ring_attention_tpu.parallel import all_gather_variable, create_mesh

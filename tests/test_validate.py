@@ -9,7 +9,7 @@ an einsum or silently computing nonsense on a transposed layout.
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import shard_map
+from ring_attention_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ring_attention_tpu.models import RingAttention, RingTransformer

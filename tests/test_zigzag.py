@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from ring_attention_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ring_attention_tpu.ops import apply_rotary, default_attention, rotary_freqs

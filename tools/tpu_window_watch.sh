@@ -16,21 +16,61 @@ set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${1:-600}
 LOCK=/tmp/tpu_window_watch.lock
+# a takeover candidate must be at least this old (seconds): a lock younger
+# than this belongs to a watcher that is still starting up, never stale
+MIN_LOCK_AGE=${TPU_WATCH_LOCK_MIN_AGE:-60}
+
 # PID-stamped lock with staleness takeover: a SIGKILLed watcher (EXIT trap
 # never runs) must not permanently block future watchers — an unwatched
 # window opening unnoticed is the exact failure this tool prevents.
-if ! mkdir "$LOCK" 2>/dev/null; then
+#
+# Acquisition is ATOMIC: the pid is written into a temp dir which is
+# rename(2)d into place, so a held lock always contains its pid — there is
+# no window where a concurrent starter reads an empty pid, declares the
+# lock stale, and proceeds alongside the holder (the round-5 advisor
+# race).  rename onto an existing non-empty directory fails, so exactly
+# one of N concurrent acquirers wins.
+acquire_lock() {
+  local tmp
+  tmp=$(mktemp -d "${LOCK}.acquire.XXXXXX") || return 1
+  echo $$ > "$tmp/pid"
+  if mv -T "$tmp" "$LOCK" 2>/dev/null; then
+    return 0
+  fi
+  rm -rf "$tmp"
+  return 1
+}
+
+if ! acquire_lock; then
   oldpid=$(cat "$LOCK/pid" 2>/dev/null)
+  lock_mtime=$(stat -c %Y "$LOCK" 2>/dev/null || echo 0)
+  lock_age=$(( $(date +%s) - lock_mtime ))
   if [ -n "$oldpid" ] && kill -0 "$oldpid" 2>/dev/null; then
     echo "another window watcher is running (pid $oldpid)" >&2
     echo "$(date -u +%H:%M:%S) watcher refused: pid $oldpid alive" >> /tmp/tpu_health.log
     exit 1
   fi
-  echo "$(date -u +%H:%M:%S) stale watcher lock (pid ${oldpid:-unknown} dead), taking over" >> /tmp/tpu_health.log
-  rm -rf "$LOCK"
-  mkdir "$LOCK" || exit 1
+  # stale ONLY when all three hold: the pid file exists, its pid is dead,
+  # and the lock is old enough that no healthy starter could still own it
+  if [ -z "$oldpid" ] || [ "$lock_age" -lt "$MIN_LOCK_AGE" ]; then
+    echo "watcher lock $LOCK in indeterminate state (pid=${oldpid:-none}, age=${lock_age}s); refusing" >&2
+    echo "$(date -u +%H:%M:%S) watcher refused: lock indeterminate (pid=${oldpid:-none}, age=${lock_age}s)" >> /tmp/tpu_health.log
+    exit 1
+  fi
+  echo "$(date -u +%H:%M:%S) stale watcher lock (pid $oldpid dead, age ${lock_age}s), taking over" >> /tmp/tpu_health.log
+  # atomic takeover: rename the stale lock aside first — of N concurrent
+  # takeover attempts exactly one mv wins; the losers must NOT rm -rf (a
+  # plain rm here could delete the winner's freshly acquired lock)
+  if ! mv -T "$LOCK" "$LOCK.stale.$$" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) watcher lost takeover race; exiting" >> /tmp/tpu_health.log
+    exit 1
+  fi
+  rm -rf "$LOCK.stale.$$"
+  if ! acquire_lock; then
+    echo "$(date -u +%H:%M:%S) watcher lost takeover race; exiting" >> /tmp/tpu_health.log
+    exit 1
+  fi
 fi
-echo $$ > "$LOCK/pid"
 trap 'rm -rf "$LOCK" 2>/dev/null' EXIT
 
 while true; do
