@@ -117,6 +117,9 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     if mode == "decode":
         _decode_worker(impl, seq_len, extra)
         return
+    if mode == "packed":
+        _packed_worker(impl, seq_len, extra)
+        return
 
     heads = int(extra.get("heads", HEADS))
     kv_heads = int(extra.get("kv_heads", heads))
@@ -374,24 +377,17 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
     )
 
 
-def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
-                  vocab: int = 256,
-                  loss_chunk_size: int | None = None) -> None:
-    """Full train step (fwd+bwd+adam) tokens/sec on one chip.
-
-    ``remat_policy="save_attn"`` saves each layer's flash output + lse so
-    the backward skips re-running the O(n^2) attention forward (VERDICT r2
-    weak #1: the elective recompute cost the r2 headline ~2 s/step).
-    ``vocab``/``loss_chunk_size`` measure the realistic-vocabulary
-    configuration: at vocab 50257 the full-logits CE cannot fit a chip at
-    262k tokens, so the chunked loss is what makes the shape trainable."""
+def _bench_transformer(impl: str, vocab: int, remat_policy: str | None,
+                       loss_chunk_size: int | None = None):
+    """The ONE benchmark RingTransformer config + its init, shared by the
+    train and packed workers so their tokens/sec stay comparable (same
+    dims, remat, dtype; params are seq-independent so init runs on a
+    short sequence to keep it cheap)."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     from ring_attention_tpu.models import RingTransformer
 
-    dev, _ = _device_peak()
     model = RingTransformer(
         num_tokens=vocab,
         dim=512,
@@ -407,9 +403,116 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
         loss_chunk_size=loss_chunk_size,
         dtype=jnp.bfloat16,
     )
-    # params are seq-independent: init on a short sequence to keep init cheap
     init_tokens = jnp.zeros((1, 129), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), init_tokens, return_loss=True)
+    params = model.init(jax.random.PRNGKey(0), init_tokens, return_loss=True,
+                        segment_ids=jnp.zeros((1, 129), jnp.int32))
+    return model, params
+
+
+def _packed_worker(impl: str, seq_len: int, extra: dict) -> None:
+    """Packed vs padded train-step throughput at one position budget.
+
+    Real corpora are unequal documents.  The *padded* batch mimics the
+    classic recipe: ``docs`` fixed slots per row, each holding a document
+    filling 75% of the slot plus 25% pad (pad slots carry their own
+    segment id, so they attend nothing real — but they still occupy
+    positions).  The *packed* batch fills every position with a document
+    token under segment-id masking.  Same (1, seq_len) compiled shapes,
+    same step cost structure; the honest metric is USEFUL tokens/sec —
+    what the padded recipe wastes, packing recovers (the tentpole win),
+    on top of the kernels skipping/masking cross-document attention.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ring_attention_tpu.utils import make_train_step
+    from ring_attention_tpu.utils.benchtime import timed_chained
+
+    docs = int(extra.get("docs", 8))
+    pad_frac = float(extra.get("pad_frac", 0.25))
+    vocab = int(extra.get("vocab", 256))
+    dev, _ = _device_peak()
+    if seq_len % docs:
+        raise ValueError(
+            f"packed worker: docs={docs} must divide seq_len={seq_len}"
+        )
+    slot = seq_len // docs
+
+    model, params = _bench_transformer(impl, vocab, "save_attn")
+    opt = optax.adam(1e-3)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, seq_len + 1), 0, vocab, jnp.int32
+    )
+    # segment rows span seq_len + 1 tokens (the model shifts labels off the
+    # last token); the final doc simply extends one slot position
+    def with_tail(row):
+        return jnp.asarray(np.append(row, row[-1])[None, :])
+
+    # packed: docs equal slots, every position useful
+    seg_packed = with_tail(np.repeat(np.arange(docs, dtype=np.int32), slot))
+    # padded: each slot = useful prefix + pad tail in its own segment
+    useful = int(slot * (1.0 - pad_frac))
+    row = np.repeat(np.arange(docs, dtype=np.int32) * 2, slot)
+    for i in range(docs):
+        row[i * slot + useful:(i + 1) * slot] = 2 * i + 1  # pad segment
+    seg_padded = with_tail(row)
+
+    step = make_train_step(
+        lambda p, t, s: model.apply(p, t, return_loss=True, segment_ids=s),
+        opt,
+    )
+    iters = 3 if seq_len >= 65536 else 5
+
+    def chained(params, opt_state, tokens, segs):
+        def body(carry, _):
+            params, opt_state = carry
+            params, opt_state, loss = step(params, opt_state, tokens, segs)
+            return (params, opt_state), loss
+        _, losses = jax.lax.scan(body, (params, opt_state), None, length=iters)
+        return losses[-1]
+
+    chained = jax.jit(chained)
+    out = {"packed_seq_len": seq_len, "packed_docs": docs,
+           "packed_pad_frac": pad_frac, "packed_impl": impl,
+           "device": getattr(dev, "device_kind", str(dev))}
+    for label, segs, n_useful in (
+        ("packed", seg_packed, seq_len),
+        ("padded", seg_padded, docs * useful),
+    ):
+        opt_state = opt.init(params)
+        compile_s, secs = timed_chained(
+            chained, (params, opt_state, tokens, segs), iters
+        )
+        out[f"{label}_tokens_per_sec"] = round(n_useful / secs)
+        out[f"{label}_ms_per_step"] = round(secs * 1e3, 2)
+        out[f"{label}_compile_s"] = round(compile_s, 1)
+    out["packed_speedup"] = round(
+        out["packed_tokens_per_sec"] / max(out["padded_tokens_per_sec"], 1), 3
+    )
+    print(json.dumps(out))
+
+
+def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
+                  vocab: int = 256,
+                  loss_chunk_size: int | None = None) -> None:
+    """Full train step (fwd+bwd+adam) tokens/sec on one chip.
+
+    ``remat_policy="save_attn"`` saves each layer's flash output + lse so
+    the backward skips re-running the O(n^2) attention forward (VERDICT r2
+    weak #1: the elective recompute cost the r2 headline ~2 s/step).
+    ``vocab``/``loss_chunk_size`` measure the realistic-vocabulary
+    configuration: at vocab 50257 the full-logits CE cannot fit a chip at
+    262k tokens, so the chunked loss is what makes the shape trainable."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    dev, _ = _device_peak()
+    model, params = _bench_transformer(impl, vocab, remat_policy,
+                                       loss_chunk_size)
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
 
@@ -679,6 +782,34 @@ def main() -> None:
                 key=lambda p: (p["train_seq_len"], p["tokens_per_sec"]),
             )
             result.update(winner)
+
+    # phase 3b — packed-sequence (segment-id) train throughput vs the
+    # padded recipe at the same position budget (~25% pad): the packed
+    # entry (`packed262k` at the north-star seq) sits next to the train
+    # tokens/sec entries; `packed_speedup` is the pad-waste recovery
+    if best is not None:
+        impl = best[0]
+        packed_seqs = []
+        for s in (TARGET_SEQ, best[1], 8192):
+            if s >= 1024 and s not in packed_seqs:
+                packed_seqs.append(s)
+        for seq in packed_seqs:
+            if not budget_left(1200):
+                log.append(f"packed:{impl}@{seq}: skipped (budget exhausted)")
+                continue
+            payload, err = _run_attempt(
+                impl, seq, "packed", min(1200, deadline - time.monotonic())
+            )
+            if payload is not None:
+                key = "packed262k" if seq == TARGET_SEQ else f"packed{seq}"
+                result[key] = payload["packed_tokens_per_sec"]
+                result["packed_seq_len"] = payload["packed_seq_len"]
+                result["padded_tokens_per_sec"] = payload["padded_tokens_per_sec"]
+                result["packed_speedup"] = payload["packed_speedup"]
+                result["packed_pad_frac"] = payload["packed_pad_frac"]
+                log.append(f"packed:{impl}@{seq}: ok")
+                break
+            log.append(err)
 
     # phase 4 — ring-hop sequence on one chip: the per-device span calls a
     # real causal ring makes (resume + fused last hop).  Done criterion:

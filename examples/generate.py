@@ -39,6 +39,9 @@ def main() -> None:
                          "generate() (0 = greedy token-by-token streaming)")
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="persistent XLA compilation cache directory: "
+                         "repeated runs skip recompiles (utils/benchtime.py)")
     args = ap.parse_args()
 
     if args.temperature <= 0.0 and (args.top_k is not None
@@ -58,11 +61,22 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    import warnings
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from ring_attention_tpu import RingTransformer, create_mesh
+    from ring_attention_tpu.utils import compat, enable_compile_cache
+
+    if args.compile_cache_dir:
+        # before any jit: every compile from here on lands in the cache
+        enable_compile_cache(args.compile_cache_dir)
+    # CPU dev boxes can't honor donation; the hint is still correct on TPU
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
 
     n_dev = len(jax.devices())
     mesh = create_mesh(ring_size=n_dev) if n_dev > 1 else None
@@ -95,10 +109,13 @@ def main() -> None:
     cache = model.apply(params, 1, args.max_len, method=RingTransformer.init_cache)
     logits, cache = model.apply(params, prompt, cache, method=RingTransformer.prefill)
 
-    step = jax.jit(
+    # donate the KV cache: each step's updated cache reuses the previous
+    # step's buffers instead of double-allocating the whole cache
+    step = compat.jit(
         lambda p, tok, c, i: model.apply(
             p, tok, c, i, method=RingTransformer.decode_step
-        )
+        ),
+        donate_argnums=(2,),
     )
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     toks = [int(tok[0])]

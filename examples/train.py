@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import warnings
 
 try:  # prefer the installed package (pip install -e .)
     import ring_attention_tpu  # noqa: F401
@@ -44,6 +45,16 @@ def main() -> None:
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     ap.add_argument("--bidirectional", action="store_true",
                     help="circulate KV halves both ring directions (duplex ICI)")
+    ap.add_argument("--pack", action="store_true",
+                    help="packed-sequence training: concatenate variable-"
+                         "length documents per row with segment ids — "
+                         "attention stays within each document and no "
+                         "position is padding (docs/packing.md)")
+    ap.add_argument("--docs-per-seq", type=int, default=4,
+                    help="documents packed into each row with --pack")
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="persistent XLA compilation cache directory: "
+                         "repeated runs skip recompiles (utils/benchtime.py)")
     ap.add_argument("--ckpt-dir", default=None,
                     help="checkpoint directory: saves every --ckpt-every "
                          "steps and resumes from the last good checkpoint "
@@ -76,8 +87,17 @@ def main() -> None:
     from ring_attention_tpu.utils import (
         CheckpointManager,
         StepTimer,
+        enable_compile_cache,
         init_step_stats,
         make_train_step,
+    )
+
+    if args.compile_cache_dir:
+        # before any jit: every compile from here on lands in the cache
+        enable_compile_cache(args.compile_cache_dir)
+    # CPU dev boxes can't honor donation; the hint is still correct on TPU
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
     )
 
     n_dev = len(jax.devices())
@@ -104,28 +124,63 @@ def main() -> None:
     )
 
     rng = np.random.default_rng(0)
-    # synthetic "copy task" data: predictable structure so loss falls fast
-    base = rng.integers(0, 256, (args.batch, args.seq_len // 2))
-    tokens = np.concatenate([base, base], axis=1).astype(np.int32)
+    segments = None
+    if args.pack:
+        # packed batches: each row concatenates --docs-per-seq variable-
+        # length "copy task" documents; segment ids keep attention (and
+        # the loss) within each document — zero positions are padding
+        tokens = np.empty((args.batch, args.seq_len), np.int32)
+        segments = np.empty((args.batch, args.seq_len), np.int32)
+        for row in range(args.batch):
+            cuts = np.sort(rng.choice(
+                np.arange(2, args.seq_len - 1, 2),
+                size=args.docs_per_seq - 1, replace=False,
+            ))
+            bounds = [0, *cuts.tolist(), args.seq_len]
+            for doc, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
+                half = (hi - lo) // 2
+                piece = rng.integers(0, 256, half + ((hi - lo) % 2))
+                tokens[row, lo:hi] = np.concatenate([piece, piece[:half]])
+                segments[row, lo:hi] = doc
+    else:
+        # synthetic "copy task" data: predictable structure so loss falls fast
+        base = rng.integers(0, 256, (args.batch, args.seq_len // 2))
+        tokens = np.concatenate([base, base], axis=1).astype(np.int32)
 
     if mesh is not None:
         # host array straight onto the mesh: batch over data, sequence over
         # the ring, one per-shard transfer (multi-host: each process passes
         # its local slice)
         tokens = shard_batch(tokens, mesh)
+        if segments is not None:
+            segments = shard_batch(segments, mesh)
     else:
         tokens = jnp.asarray(tokens)
+        if segments is not None:
+            segments = jnp.asarray(segments)
     params = model.init(jax.random.PRNGKey(0), tokens)
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)
 
+    if args.pack:
+        def loss_fn(p, t, s):
+            return model.apply(p, t, return_loss=True, segment_ids=s)
+        batch = (tokens, segments)
+    else:
+        def loss_fn(p, t):
+            return model.apply(p, t, return_loss=True)
+        batch = (tokens,)
+
     guarded = args.skip_nonfinite
-    train_step = jax.jit(make_train_step(
-        lambda p, t: model.apply(p, t, return_loss=True), opt,
+    # jit_donate: (params, opt_state) buffers are donated so XLA updates
+    # them in place instead of double-allocating model + Adam state
+    train_step = make_train_step(
+        loss_fn, opt,
         accum_steps=args.accum_steps,
         skip_nonfinite=guarded,
         clip_grad_norm=args.clip_grad_norm,
-    ))
+        jit_donate=True,
+    )
 
     # preemption-safe resume: atomic saves, keep-last-N, corrupt-checkpoint
     # fallback — kill this process at any point and rerun the same command
@@ -150,10 +205,10 @@ def main() -> None:
     for step in range(start, args.steps):
         if guarded:
             params, opt_state, stats, loss = train_step(
-                params, opt_state, stats, tokens
+                params, opt_state, stats, *batch
             )
         else:
-            params, opt_state, loss = train_step(params, opt_state, tokens)
+            params, opt_state, loss = train_step(params, opt_state, *batch)
         timer.step(loss)
         if step % 5 == 0 or step == args.steps - 1:
             skipped = int(stats.skipped) if guarded else 0

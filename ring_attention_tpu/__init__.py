@@ -14,6 +14,8 @@ __version__ = "0.1.0"
 from .models import FeedForward, RingAttention, RingTransformer, RMSNorm
 from .utils import StepTimer, restore_checkpoint, save_checkpoint, trace
 from .ops import (
+    PAD_SEGMENT_ID,
+    SegmentIds,
     apply_rotary,
     default_attention,
     flash_attention,
@@ -44,6 +46,8 @@ from .parallel import (
 
 __all__ = [
     "FeedForward",
+    "PAD_SEGMENT_ID",
+    "SegmentIds",
     "StepTimer",
     "all_gather_variable",
     "axis_rank",

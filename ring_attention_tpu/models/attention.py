@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import default_attention
+from ..ops.attention import PAD_SEGMENT_ID, default_attention
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import (
     QuantizedKV,
@@ -41,7 +41,12 @@ from ..ops.pallas_flash import (
 from ..ops.rotary import apply_rotary, ring_positions, rotary_freqs
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.ring import ring_flash_attention
-from ..parallel.sharding import pad_seq_and_mask, stripe_permute, stripe_unpermute
+from ..parallel.sharding import (
+    pad_seq_and_mask,
+    pad_to_multiple,
+    stripe_permute,
+    stripe_unpermute,
+)
 from ..parallel.tree_decode import tree_attn_decode
 from ..parallel.ulysses import ulysses_attention
 from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
@@ -161,6 +166,7 @@ class RingAttention(nn.Module):
         self,
         x: jax.Array,
         mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
     ) -> jax.Array:
         """``x: (b, n, dim)`` -> ``(b, n, dim)``.
 
@@ -168,6 +174,12 @@ class RingAttention(nn.Module):
         array: it is padded to the ring size, stripe-permuted if ``striped``,
         and constrained onto the ``(data, seq)`` mesh; the inverse is applied
         to the output (ref ``ring_attention.py:389-403,458-464``).
+
+        ``segment_ids: (b, n)`` int document ids enable packed-sequence
+        attention (cross-document attention masked; whole tiles/hops
+        skipped where possible — see ``docs/packing.md``).  Padding added
+        by ``auto_shard`` gets ``PAD_SEGMENT_ID``, which matches no real
+        document.
         """
         check_model_input("RingAttention", x, self.dim)
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
@@ -184,12 +196,20 @@ class RingAttention(nn.Module):
                 else self._ring_size()
             )
             x, mask, n_orig = pad_seq_and_mask(x, mask, pad_mult)
+            if segment_ids is not None:
+                segment_ids, _ = pad_to_multiple(
+                    segment_ids, pad_mult, value=PAD_SEGMENT_ID
+                )
             if self.sequence_parallel == "ring" and self.striped:
                 x = stripe_permute(x, self._ring_size())
                 if mask is not None:
                     mask = stripe_permute(mask, self._ring_size())
+                if segment_ids is not None:
+                    segment_ids = stripe_permute(segment_ids, self._ring_size())
             elif self.sequence_parallel == "zigzag":
                 x = zigzag_permute(x, self._ring_size())
+                if segment_ids is not None:
+                    segment_ids = zigzag_permute(segment_ids, self._ring_size())
             x = lax.with_sharding_constraint(
                 x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
             )
@@ -201,9 +221,9 @@ class RingAttention(nn.Module):
             mask = None  # ref asserts causal and key-pad mask are exclusive
 
         if ring:
-            out = self._sp_attend(q, k, v, mask)
+            out = self._sp_attend(q, k, v, mask, segment_ids)
         else:
-            out = self._local_attend(q, k, v, mask)
+            out = self._local_attend(q, k, v, mask, segment_ids)
 
         out = out.transpose(0, 2, 1, 3).reshape(b, n, self.heads * self.dim_head)
         out = self.to_out(out)
@@ -216,7 +236,7 @@ class RingAttention(nn.Module):
             out = out[:, :n_orig]
         return out
 
-    def _local_attend(self, q, k, v, mask):
+    def _local_attend(self, q, k, v, mask, segment_ids=None):
         n = q.shape[2]
         if self.rotary:
             freqs = rotary_freqs(jnp.arange(n), self.dim_head, self.rotary_theta)
@@ -227,19 +247,22 @@ class RingAttention(nn.Module):
             return default_attention(
                 q, k, v, mask, causal=self.causal,
                 softclamp_value=self.softclamp_value,
+                segment_ids=segment_ids,
             )
         if self._use_pallas():
             return pallas_flash_attention(
                 q, k, v, mask, causal=self.causal, window=window,
                 softclamp_value=self.softclamp_value,
                 head_chunks=self.pallas_head_chunks,
+                segment_ids=segment_ids,
             )
         return flash_attention(
             q, k, v, mask, causal=self.causal, bucket_size=self.bucket_size,
             window=window, softclamp_value=self.softclamp_value,
+            segment_ids=segment_ids,
         )
 
-    def _sp_attend(self, q, k, v, mask):
+    def _sp_attend(self, q, k, v, mask, segment_ids=None):
         """Dispatch to the configured context-parallel scheme."""
         ring_size = self._ring_size()
         n = q.shape[2]
@@ -249,16 +272,21 @@ class RingAttention(nn.Module):
             "use auto_shard=True to pad"
         )
         if self.sequence_parallel == "zigzag":
-            return self._zigzag_attend(q, k, v)
+            return self._zigzag_attend(q, k, v, segment_ids)
         if self.sequence_parallel == "ulysses":
-            return self._ulysses_attend(q, k, v, mask)
-        return self._ring_attend(q, k, v, mask)
+            return self._ulysses_attend(q, k, v, mask, segment_ids)
+        return self._ring_attend(q, k, v, mask, segment_ids)
 
-    def _zigzag_attend(self, q, k, v):
+    @staticmethod
+    def _seg_spec(segment_ids):
+        """shard_map spec for an optional (b, n) segment-id operand."""
+        return P(DATA_AXIS, SEQ_AXIS) if segment_ids is not None else P()
+
+    def _zigzag_attend(self, q, k, v, segment_ids=None):
         ring_size = self._ring_size()
         n_local = q.shape[2] // ring_size
 
-        def core(q, k, v):
+        def core(q, k, v, seg):
             if self.rotary:
                 rank = lax.axis_index(SEQ_AXIS)
                 pos = zigzag_positions(n_local, rank, ring_size)
@@ -270,20 +298,22 @@ class RingAttention(nn.Module):
                 bucket_size=self.bucket_size,
                 softclamp_value=self.softclamp_value,
                 impl="pallas" if self._use_pallas() else "xla",
+                segment_ids=seg,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
         return compat.shard_map(
             core, mesh=self.mesh,
-            in_specs=(qspec, qspec, qspec), out_specs=qspec,
+            in_specs=(qspec, qspec, qspec, self._seg_spec(segment_ids)),
+            out_specs=qspec,
             check_vma=not self._use_pallas(),
-        )(q, k, v)
+        )(q, k, v, segment_ids)
 
-    def _ulysses_attend(self, q, k, v, mask):
+    def _ulysses_attend(self, q, k, v, mask, segment_ids=None):
         ring_size = self._ring_size()
         n_local = q.shape[2] // ring_size
 
-        def core(q, k, v, mask):
+        def core(q, k, v, mask, seg):
             if self.rotary:
                 rank = lax.axis_index(SEQ_AXIS)
                 pos = ring_positions(n_local, rank, striped=False, world=ring_size)
@@ -298,17 +328,19 @@ class RingAttention(nn.Module):
                 window=self.max_lookback_seq_len,
                 softclamp_value=self.softclamp_value,
                 impl="pallas" if self._use_pallas() else "xla",
+                segment_ids=seg,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
         mspec = P(DATA_AXIS, SEQ_AXIS) if mask is not None else P()
         return compat.shard_map(
             core, mesh=self.mesh,
-            in_specs=(qspec, qspec, qspec, mspec), out_specs=qspec,
+            in_specs=(qspec, qspec, qspec, mspec, self._seg_spec(segment_ids)),
+            out_specs=qspec,
             check_vma=not self._use_pallas(),
-        )(q, k, v, mask)
+        )(q, k, v, mask, segment_ids)
 
-    def _ring_attend(self, q, k, v, mask):
+    def _ring_attend(self, q, k, v, mask, segment_ids=None):
         ring_size = self._ring_size()
         n = q.shape[2]
         n_local = n // ring_size
@@ -339,7 +371,7 @@ class RingAttention(nn.Module):
             # non-striped for windowed attention: the window itself balances
             # causal load and allows hop skipping.
 
-        def core(q, k, v, mask):
+        def core(q, k, v, mask, seg):
             rank = lax.axis_index(SEQ_AXIS)
             if self.rotary:
                 pos = ring_positions(
@@ -357,6 +389,7 @@ class RingAttention(nn.Module):
                 self.softclamp_value, None,
                 "pallas" if self._use_pallas() else "xla",
                 bidirectional, self.ring_dkv_dtype,
+                segment_ids=seg,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
@@ -364,12 +397,12 @@ class RingAttention(nn.Module):
         return compat.shard_map(
             core,
             mesh=self.mesh,
-            in_specs=(qspec, qspec, qspec, mspec),
+            in_specs=(qspec, qspec, qspec, mspec, self._seg_spec(segment_ids)),
             out_specs=qspec,
             # pallas_call with device-varying scalars trips jax's vma
             # checker; jax suggests check_vma=False as the workaround
             check_vma=not self._use_pallas(),
-        )(q, k, v, mask)
+        )(q, k, v, mask, segment_ids)
 
     # ------------------------------------------------------------------
     # Incremental decoding (beyond reference parity)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.attention import PAD_SEGMENT_ID
 from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
 from ..parallel.sharding import pad_to_multiple, stripe_permute, stripe_unpermute
 from ..parallel.zigzag import zigzag_permute, zigzag_unpermute
@@ -197,6 +198,7 @@ class RingTransformer(nn.Module):
         mask: jax.Array | None = None,
         return_loss: bool = False,
         example_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
     ) -> jax.Array:
         """``tokens: (b, n)`` int32 -> logits ``(b, n, num_tokens)`` or scalar loss.
 
@@ -206,11 +208,25 @@ class RingTransformer(nn.Module):
         exercised by ``assert_attn.py:81-82``) — ragged data-parallel
         shards are padded to a common batch and the pad rows drop out of
         the loss here.
+
+        ``segment_ids: (b, n)`` int document ids pack multiple documents
+        into one sequence: every attention layer masks (and where possible
+        skips) cross-document attention, and the loss drops positions
+        whose label crosses a document boundary (the first token of each
+        packed document is never predicted from the previous document).
+        See ``docs/packing.md``.
         """
         check_tokens_input("RingTransformer", tokens)
+        segment_same = None
         if return_loss:
             labels = tokens[:, 1:]
             tokens = tokens[:, :-1]
+            if segment_ids is not None:
+                # label at position i is token i+1: valid only when both
+                # sit in the same document (no loss on each doc's first
+                # token — it would be "predicted" from the previous doc)
+                segment_same = segment_ids[:, 1:] == segment_ids[:, :-1]
+                segment_ids = segment_ids[:, :-1]
 
         ring = self._ring_size()
         n_orig = tokens.shape[1]
@@ -243,6 +259,16 @@ class RingTransformer(nn.Module):
                     mask = stripe_permute(mask, ring)
                 elif zigzag:
                     mask = zigzag_permute(mask, ring)
+            if segment_ids is not None:
+                # pad slots get PAD_SEGMENT_ID: their own "document",
+                # attending nothing real (models/attention.py does the
+                # same for its per-layer padding)
+                segment_ids, _ = pad_to_multiple(segment_ids, pad_mult,
+                                                 value=PAD_SEGMENT_ID)
+                if striped:
+                    segment_ids = stripe_permute(segment_ids, ring)
+                elif zigzag:
+                    segment_ids = zigzag_permute(segment_ids, ring)
 
         x = self.embed(tokens)
         if ring > 1 and self.auto_shard:
@@ -251,7 +277,7 @@ class RingTransformer(nn.Module):
             )
 
         for attn, ff in zip(self.attn_layers, self.ff_layers):
-            x = attn(x, mask) + x
+            x = attn(x, mask, segment_ids) + x
             x = ff(x) + x
 
         x = self.final_norm(x)
@@ -268,7 +294,8 @@ class RingTransformer(nn.Module):
                     x = zigzag_unpermute(x, ring)
                 x = x[:, :n_orig]
             return self._chunked_ce(
-                x, labels, self._valid_labels(labels, example_mask)
+                x, labels,
+                self._valid_labels(labels, example_mask, segment_same),
             )
 
         logits = self.to_logits(x)
@@ -284,18 +311,25 @@ class RingTransformer(nn.Module):
             return logits
 
         # Cross-entropy with ignore_index (ref ring_attention.py:664-673)
-        valid = self._valid_labels(labels, example_mask)
+        valid = self._valid_labels(labels, example_mask, segment_same)
         nll = _position_nll(logits, labels, valid)
         return nll.sum() / jnp.maximum(valid.sum(), 1)
 
     def _valid_labels(
-        self, labels: jax.Array, example_mask: jax.Array | None
+        self,
+        labels: jax.Array,
+        example_mask: jax.Array | None,
+        segment_same: jax.Array | None = None,
     ) -> jax.Array:
         """Which (b, n) label slots count toward the loss — the ONE place
-        the ignore_index / example_mask rule lives (both CE paths use it)."""
+        the ignore_index / example_mask / packed-boundary rule lives (both
+        CE paths use it).  ``segment_same`` marks labels living in the same
+        document as the token predicting them."""
         valid = labels != self.ignore_index
         if example_mask is not None:
             valid = valid & example_mask[:, None]
+        if segment_same is not None:
+            valid = valid & segment_same
         return valid
 
     def _chunked_ce(

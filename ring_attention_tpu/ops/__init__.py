@@ -1,4 +1,13 @@
-from .attention import default_attention, softclamp, MASK_VALUE, EPSILON
+from .attention import (
+    default_attention,
+    normalize_segment_ids,
+    segments_overlap,
+    softclamp,
+    MASK_VALUE,
+    EPSILON,
+    PAD_SEGMENT_ID,
+    SegmentIds,
+)
 from .flash import (
     FlashCarry,
     attend_blocks,
@@ -31,6 +40,8 @@ def attention(
     q_chunk_size: int | None = None,
     head_chunks: int | None = None,
     interpret: bool | None = None,
+    segment_ids=None,
+    doc_starts: tuple[int, ...] | None = None,
 ):
     """Single-device attention entry point with graceful kernel degradation.
 
@@ -52,8 +63,9 @@ def attention(
       Pallas would be a pessimization there, not a fallback.
 
     ``bucket_size``/``q_chunk_size`` apply to the XLA path,
-    ``head_chunks``/``interpret`` to the Pallas path; both sets are legal
-    with ``impl="auto"`` (whichever path runs uses its own).
+    ``head_chunks``/``interpret``/``doc_starts`` to the Pallas path; both
+    sets are legal with ``impl="auto"`` (whichever path runs uses its
+    own).  ``segment_ids`` (packed sequences) applies to both.
     """
     from ..utils import resilience
     from ..utils.validate import check_attention_args
@@ -70,11 +82,23 @@ def attention(
                 f"heads={h} and kv_heads={hk}"
             )
 
+    # doc_starts is a SEMANTIC input (a declared packing layout), not a
+    # perf knob: a path that cannot resolve it into kernel tables must
+    # realize it as runtime segment ids, never silently drop it — the
+    # XLA fallback would otherwise compute cross-document attention
+    xla_segment_ids = segment_ids
+    if doc_starts is not None and segment_ids is None:
+        from .pallas_flash import _check_doc_starts, _doc_runtime_ids
+
+        nq, nk = q.shape[2], k.shape[2]
+        _check_doc_starts(doc_starts, nq, nk)
+        xla_segment_ids = _doc_runtime_ids(doc_starts, nq, q.shape[0])
+
     def run_xla():
         return flash_attention(
             q, k, v, mask, causal=causal, window=window,
             softclamp_value=softclamp_value, bucket_size=bucket_size,
-            q_chunk_size=q_chunk_size,
+            q_chunk_size=q_chunk_size, segment_ids=xla_segment_ids,
         )
 
     def run_pallas():
@@ -82,7 +106,8 @@ def attention(
         return pallas_flash_attention(
             q, k, v, mask, causal=causal, window=window,
             softclamp_value=softclamp_value, head_chunks=head_chunks,
-            interpret=interpret,
+            interpret=interpret, segment_ids=segment_ids,
+            doc_starts=doc_starts,
         )
 
     resolved = resilience.resolve_attention_impl(impl)
@@ -102,6 +127,10 @@ def attention(
 
 __all__ = [
     "attention",
+    "normalize_segment_ids",
+    "segments_overlap",
+    "PAD_SEGMENT_ID",
+    "SegmentIds",
     "QuantizedKV",
     "pallas_flash_attention",
     "pallas_flash_decode",

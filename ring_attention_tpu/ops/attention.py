@@ -20,6 +20,7 @@ Layout convention for all ops in this package: ``q: (b, h, n, d)``,
 from __future__ import annotations
 
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,57 @@ import jax.numpy as jnp
 # ``-torch.finfo(dtype).max`` the same way.
 MASK_VALUE = -0.5 * float(jnp.finfo(jnp.float32).max)
 EPSILON = 1e-10  # ref ring_attention_pytorch/ring_flash_attention.py:25
+
+# Segment id reserved for padding: never equal to a real document id
+# (real ids must be >= 0), so pad queries/keys attend only each other.
+PAD_SEGMENT_ID = -1
+
+
+class SegmentIds(NamedTuple):
+    """Per-token document ids for packed-sequence (segment) attention.
+
+    A query at row ``i`` may attend a key at column ``j`` only when
+    ``q[.., i] == kv[.., j]`` (in addition to any causal band / key-padding
+    mask / lookback window).  Same convention as the splash-attention
+    kernels' ``SegmentIds``.  Real ids are ``>= 0``; ``PAD_SEGMENT_ID``
+    marks padding.
+    """
+
+    q: jax.Array  # (b, nq) int32
+    kv: jax.Array  # (b, nk) int32
+
+
+def normalize_segment_ids(segment_ids, q, k, fn: str = "attention"):
+    """``(q_seg, kv_seg)`` int32 arrays from the public ``segment_ids`` arg.
+
+    Accepts a single ``(b, n)`` array (self-attention: used for both sides,
+    requires ``nq == nk``), a ``(q, kv)`` pair / :class:`SegmentIds`, or
+    None -> ``(None, None)``.  Shape-validated against q/k at trace time.
+    """
+    if segment_ids is None:
+        return None, None
+    from ..utils.validate import check_segment_ids
+
+    if isinstance(segment_ids, (tuple, list, SegmentIds)):
+        q_seg, kv_seg = segment_ids
+    else:
+        q_seg = kv_seg = segment_ids
+    q_seg = jnp.asarray(q_seg)
+    kv_seg = jnp.asarray(kv_seg)
+    check_segment_ids(fn, q, k, q_seg, kv_seg)
+    return q_seg.astype(jnp.int32), kv_seg.astype(jnp.int32)
+
+
+def segments_overlap(q_seg: jax.Array, kv_seg: jax.Array) -> jax.Array:
+    """Conservative "any shared document?" scalar for two id blocks.
+
+    Disjoint id *ranges* imply no shared document regardless of ordering,
+    so skipping on this predicate is always sound; overlapping ranges may
+    still share nothing (the per-element mask handles those).
+    """
+    return (jnp.min(q_seg) <= jnp.max(kv_seg)) & (
+        jnp.min(kv_seg) <= jnp.max(q_seg)
+    )
 
 
 def softclamp(x: jax.Array, value: float) -> jax.Array:
@@ -45,6 +97,7 @@ def default_attention(
     *,
     causal: bool = False,
     softclamp_value: float | None = None,
+    segment_ids=None,
 ) -> jax.Array:
     """Exact dense attention oracle.
 
@@ -56,6 +109,9 @@ def default_attention(
       causal: apply a causal mask (ignores ``mask`` if set, as the reference
         asserts the two are exclusive).
       softclamp_value: if set, logits are soft-clamped to this magnitude.
+      segment_ids: packed-sequence document ids (see
+        :func:`normalize_segment_ids`); composes with every other mask —
+        cross-document logits are masked out.
 
     Returns:
       ``(b, h, nq, d)`` attention output in ``q.dtype``.
@@ -64,6 +120,7 @@ def default_attention(
     _, hk, nk, _ = k.shape
     assert h % hk == 0, "query heads must be a multiple of kv heads"
     g = h // hk
+    q_seg, kv_seg = normalize_segment_ids(segment_ids, q, k, "default_attention")
 
     scale = d**-0.5
     qg = q.reshape(b, hk, g, nq, d).astype(jnp.float32)
@@ -78,6 +135,10 @@ def default_attention(
         sim = jnp.where(j <= i + (nk - nq), sim, MASK_VALUE)
     elif mask is not None:
         sim = jnp.where(mask[:, None, None, None, :], sim, MASK_VALUE)
+
+    if q_seg is not None:
+        same = q_seg[:, None, None, :, None] == kv_seg[:, None, None, None, :]
+        sim = jnp.where(same, sim, MASK_VALUE)
 
     attn = jax.nn.softmax(sim, axis=-1)
     out = jnp.einsum("bhgij,bhjd->bhgid", attn, v.astype(jnp.float32))

@@ -42,7 +42,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
-from .attention import MASK_VALUE, EPSILON, softclamp
+from .attention import (
+    MASK_VALUE,
+    EPSILON,
+    PAD_SEGMENT_ID,
+    normalize_segment_ids,
+    segments_overlap,
+    softclamp,
+)
 from ..utils import compat
 from ..utils.validate import check_attention_args
 
@@ -121,6 +128,8 @@ def _tile_mask(
     offset: jax.Array | int | None,
     window_lo: jax.Array | int | None,
     kv_mask_tile: jax.Array | None,
+    q_seg: jax.Array | None = None,  # (b, nq)
+    kv_seg_tile: jax.Array | None = None,  # (b, bk)
 ) -> jax.Array | None:
     """Boolean (…, nq, bk) tile mask (True = attend), or None if unmasked.
 
@@ -141,6 +150,11 @@ def _tile_mask(
     if kv_mask_tile is not None:
         # (b, bk) -> (b, 1, 1, 1, bk)
         masks.append(kv_mask_tile[:, None, None, None, :])
+    if q_seg is not None:
+        # packed sequences: attend only within the same document
+        masks.append(
+            q_seg[:, None, None, :, None] == kv_seg_tile[:, None, None, None, :]
+        )
     if not masks:
         return None
     out = masks[0]
@@ -164,6 +178,22 @@ def _online_update(carry: FlashCarry, s: jax.Array, v: jax.Array) -> FlashCarry:
     return FlashCarry(acc_new, m_new, l_new)
 
 
+def _bucket_xs(b, hk, nk, d, bucket_size, k, v, kv_mask, kv_seg):
+    """Scan inputs over KV buckets as a dict pytree (optional entries
+    simply absent) — shared by the forward and backward bucket loops."""
+    nb = nk // bucket_size
+    xs = {
+        "j": jnp.arange(nb),
+        "k": k.reshape(b, hk, nb, bucket_size, d).transpose(2, 0, 1, 3, 4),
+        "v": v.reshape(b, hk, nb, bucket_size, d).transpose(2, 0, 1, 3, 4),
+    }
+    if kv_mask is not None:
+        xs["m"] = kv_mask.reshape(b, nb, bucket_size).transpose(1, 0, 2)
+    if kv_seg is not None:
+        xs["s"] = kv_seg.reshape(b, nb, bucket_size).transpose(1, 0, 2)
+    return xs
+
+
 def attend_blocks(
     q: jax.Array,  # (b, h, nq, d)
     k: jax.Array,  # (b, hk, nk, d)
@@ -176,12 +206,19 @@ def attend_blocks(
     window_lo: jax.Array | int | None = None,
     kv_mask: jax.Array | None = None,  # (b, nk) True = attend
     softclamp_value: float | None = None,
+    q_segment_ids: jax.Array | None = None,  # (b, nq) int32
+    kv_segment_ids: jax.Array | None = None,  # (b, nk) int32
 ) -> FlashCarry:
     """Fold one KV span into the running carry, scanning over KV buckets.
 
     ``window_lo`` is the band's absolute lower offset (attend iff
     ``window_lo <= j - i <= causal_offset``); for a contiguous layout with a
     token window ``w`` it is ``causal_offset - (w - 1)``.
+
+    ``q_segment_ids``/``kv_segment_ids`` restrict attention to matching
+    document ids (packed sequences); buckets whose id range provably shares
+    no document with the queries skip their score/update work entirely
+    (:func:`..attention.segments_overlap`) instead of masking it.
     """
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
@@ -189,34 +226,34 @@ def attend_blocks(
 
     if bucket_size is None or bucket_size >= nk:
         s = _tile_scores(qg, k, scale, softclamp_value)
-        mask = _tile_mask(nq, nk, 0, causal_offset, window_lo, kv_mask)
+        mask = _tile_mask(nq, nk, 0, causal_offset, window_lo, kv_mask,
+                          q_segment_ids, kv_segment_ids)
         if mask is not None:
             s = jnp.where(mask, s, MASK_VALUE)
         return _online_update(carry, s, v)
 
     assert nk % bucket_size == 0, "kv length must divide into buckets"
-    nb = nk // bucket_size
-    kb = k.reshape(b, hk, nb, bucket_size, d).transpose(2, 0, 1, 3, 4)
-    vb = v.reshape(b, hk, nb, bucket_size, d).transpose(2, 0, 1, 3, 4)
-    mb = (
-        kv_mask.reshape(b, nb, bucket_size).transpose(1, 0, 2)
-        if kv_mask is not None
-        else None
-    )
+    xs = _bucket_xs(b, hk, nk, d, bucket_size, k, v, kv_mask, kv_segment_ids)
 
     def body(c, xs):
-        if mb is None:
-            jb, k_j, v_j = xs
-            m_j = None
-        else:
-            jb, k_j, v_j, m_j = xs
-        s = _tile_scores(qg, k_j, scale, softclamp_value)
-        mask = _tile_mask(nq, bucket_size, jb * bucket_size, causal_offset, window_lo, m_j)
-        if mask is not None:
-            s = jnp.where(mask, s, MASK_VALUE)
-        return _online_update(c, s, v_j), None
+        def compute(c):
+            s = _tile_scores(qg, xs["k"], scale, softclamp_value)
+            mask = _tile_mask(
+                nq, bucket_size, xs["j"] * bucket_size, causal_offset,
+                window_lo, xs.get("m"), q_segment_ids, xs.get("s"),
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, MASK_VALUE)
+            return _online_update(c, s, xs["v"])
 
-    xs = (jnp.arange(nb), kb, vb) if mb is None else (jnp.arange(nb), kb, vb, mb)
+        if "s" not in xs:
+            return compute(c), None
+        # whole-bucket skip: untouched carry is exactly what a fully-masked
+        # bucket would leave behind (every masked p is wiped by the later
+        # online rescale / merge), minus the bucket's FLOPs
+        has = segments_overlap(q_segment_ids, xs["s"])
+        return lax.cond(has, compute, lambda c: c, c), None
+
     carry, _ = lax.scan(body, carry, xs)
     return carry
 
@@ -234,7 +271,8 @@ def finalize(carry: FlashCarry) -> tuple[jax.Array, jax.Array]:
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_impl(q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value):
+def _flash_fwd_impl(q, k, v, kv_mask, q_seg, kv_seg, scale, bucket_size,
+                    causal_offset, window, softclamp_value):
     b, h, nq, d = q.shape
     hk = k.shape[1]
     window_lo = causal_offset - (window - 1) if window is not None else None
@@ -243,6 +281,7 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, bucket_size, causal_offset, window,
         q, k, v, carry,
         scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
         window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
     )
     out_g, lse = finalize(carry)
     # named residuals: RingTransformer(remat_policy="save_attn") saves these
@@ -267,12 +306,19 @@ def flash_backward_blocks(
     window_lo: jax.Array | int | None = None,
     kv_mask: jax.Array | None = None,
     softclamp_value: float | None = None,
+    q_segment_ids: jax.Array | None = None,  # (b, nq) int32
+    kv_segment_ids: jax.Array | None = None,  # (b, nk) int32
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Flash backward over one KV span.
 
     Returns ``(dq (b,h,nq,d), dk (b,hk,nk,d), dv (b,hk,nk,d))``, all float32.
     The ring layer calls this once per backward hop and accumulates dk/dv
     into the rotating buffer (ref ``ring_flash_attention.py:292-375``).
+
+    Segment ids mask cross-document terms out of ``p`` (so dk/dv/dq carry
+    no cross-document contributions), and buckets sharing no document with
+    the queries skip straight to zero dk/dv — the backward twin of the
+    forward's whole-bucket skip.
     """
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
@@ -282,49 +328,48 @@ def flash_backward_blocks(
 
     bk = bucket_size if (bucket_size is not None and bucket_size < nk) else nk
     assert nk % bk == 0
-    nb = nk // bk
-    kb = k.reshape(b, hk, nb, bk, d).transpose(2, 0, 1, 3, 4)
-    vb = v.reshape(b, hk, nb, bk, d).transpose(2, 0, 1, 3, 4)
-    mb = (
-        kv_mask.reshape(b, nb, bk).transpose(1, 0, 2)
-        if kv_mask is not None
-        else None
-    )
+    xs = _bucket_xs(b, hk, nk, d, bk, k, v, kv_mask, kv_segment_ids)
 
     def body(dq_acc, xs):
-        if mb is None:
-            jb, k_j, v_j = xs
-            m_j = None
-        else:
-            jb, k_j, v_j, m_j = xs
-        s = _tile_scores(qg, k_j, scale, softclamp_value)
-        mask = _tile_mask(nq, bk, jb * bk, causal_offset, window_lo, m_j)
-        p = jnp.exp(s - lse[..., None])  # (b,hk,g,nq,bk)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        dv_j = jnp.einsum("bhgij,bhgid->bhjd", p, dog)
-        dp = jnp.einsum("bhgid,bhjd->bhgij", dog, v_j.astype(jnp.float32))
-        ds = p * (dp - delta[..., None])
-        if softclamp_value is not None:
-            # s is post-clamp; d(clamp)/d(raw) = 1 - (s/c)^2
-            ds = ds * (1.0 - (s / softclamp_value) ** 2)
-        ds = ds * scale
-        dk_j = jnp.einsum("bhgij,bhgid->bhjd", ds, qg.astype(jnp.float32))
-        dq_acc = dq_acc + jnp.einsum(
-            "bhgij,bhjd->bhgid", ds, k_j.astype(jnp.float32)
+        def compute(dq_acc):
+            k_j, v_j = xs["k"], xs["v"]
+            s = _tile_scores(qg, k_j, scale, softclamp_value)
+            mask = _tile_mask(nq, bk, xs["j"] * bk, causal_offset, window_lo,
+                              xs.get("m"), q_segment_ids, xs.get("s"))
+            p = jnp.exp(s - lse[..., None])  # (b,hk,g,nq,bk)
+            if mask is not None:
+                p = jnp.where(mask, p, 0.0)
+            dv_j = jnp.einsum("bhgij,bhgid->bhjd", p, dog)
+            dp = jnp.einsum("bhgid,bhjd->bhgij", dog, v_j.astype(jnp.float32))
+            ds = p * (dp - delta[..., None])
+            if softclamp_value is not None:
+                # s is post-clamp; d(clamp)/d(raw) = 1 - (s/c)^2
+                ds = ds * (1.0 - (s / softclamp_value) ** 2)
+            ds = ds * scale
+            dk_j = jnp.einsum("bhgij,bhgid->bhjd", ds, qg.astype(jnp.float32))
+            dq_acc = dq_acc + jnp.einsum(
+                "bhgij,bhjd->bhgid", ds, k_j.astype(jnp.float32)
+            )
+            return dq_acc, (dk_j, dv_j)
+
+        if "s" not in xs:
+            return compute(dq_acc)
+        zeros = match_vma(jnp.zeros((b, hk, bk, d), jnp.float32), q)
+        has = segments_overlap(q_segment_ids, xs["s"])
+        return lax.cond(
+            has, compute, lambda a: (a, (zeros, zeros)), dq_acc
         )
-        return dq_acc, (dk_j, dv_j)
 
     dq0 = match_vma(jnp.zeros((b, hk, g, nq, d), jnp.float32), q)
-    xs = (jnp.arange(nb), kb, vb) if mb is None else (jnp.arange(nb), kb, vb, mb)
     dq_g, (dkb, dvb) = lax.scan(body, dq0, xs)
     dk = dkb.transpose(1, 2, 0, 3, 4).reshape(b, hk, nk, d)
     dv = dvb.transpose(1, 2, 0, 3, 4).reshape(b, hk, nk, d)
     return _ungroup(dq_g), dk, dv
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_attention_core(q, k, v, kv_mask, causal_offset, scale, bucket_size, window, softclamp_value):
+@partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash_attention_core(q, k, v, kv_mask, q_seg, kv_seg, causal_offset,
+                          scale, bucket_size, window, softclamp_value):
     """custom_vjp core; ``causal_offset`` is an int scalar (possibly traced —
     the q-chunked path scans over per-chunk offsets) or None (no mask).
 
@@ -332,20 +377,23 @@ def _flash_attention_core(q, k, v, kv_mask, causal_offset, scale, bucket_size, w
     calls exactly like the oracle (ops/attention.py).
     """
     out, _ = _flash_fwd_impl(
-        q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value
+        q, k, v, kv_mask, q_seg, kv_seg, scale, bucket_size, causal_offset,
+        window, softclamp_value
     )
     return out
 
 
-def _flash_core_fwd(q, k, v, kv_mask, causal_offset, scale, bucket_size, window, softclamp_value):
+def _flash_core_fwd(q, k, v, kv_mask, q_seg, kv_seg, causal_offset, scale,
+                    bucket_size, window, softclamp_value):
     out, lse = _flash_fwd_impl(
-        q, k, v, kv_mask, scale, bucket_size, causal_offset, window, softclamp_value
+        q, k, v, kv_mask, q_seg, kv_seg, scale, bucket_size, causal_offset,
+        window, softclamp_value
     )
-    return out, (q, k, v, kv_mask, causal_offset, out, lse)
+    return out, (q, k, v, kv_mask, q_seg, kv_seg, causal_offset, out, lse)
 
 
 def _flash_core_bwd(scale, bucket_size, window, softclamp_value, res, do):
-    q, k, v, kv_mask, causal_offset, out, lse = res
+    q, k, v, kv_mask, q_seg, kv_seg, causal_offset, out, lse = res
     hk = k.shape[1]
     window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (_group_q(do, hk).astype(jnp.float32) * _group_q(out, hk).astype(jnp.float32)).sum(-1)
@@ -353,8 +401,10 @@ def _flash_core_bwd(scale, bucket_size, window, softclamp_value, res, do):
         do, q, k, v, lse, delta,
         scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
         window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None, None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None, None, None)
 
 
 _flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -372,6 +422,7 @@ def flash_attention(
     softclamp_value: float | None = None,
     scale: float | None = None,
     q_chunk_size: int | None = None,
+    segment_ids=None,
 ) -> jax.Array:
     """Single-device exact flash attention (GQA-aware), differentiable.
 
@@ -387,8 +438,14 @@ def flash_attention(
     ``nq x bucket`` — required for very long sequences on the XLA path (the
     Pallas kernels tile both dimensions natively).  Gradients of the shared
     K/V sum across chunks through autodiff.
+
+    ``segment_ids`` enables packed-sequence attention: a ``(b, n)`` array of
+    per-token document ids (or a ``(q_ids, kv_ids)`` pair), masking
+    cross-document logits to exactly zero weight and skipping KV buckets
+    that share no document with the queries (see ``docs/packing.md``).
     """
     check_attention_args("flash_attention", q, k, v, mask)
+    q_seg, kv_seg = normalize_segment_ids(segment_ids, q, k, "flash_attention")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if window is not None:
@@ -398,7 +455,7 @@ def flash_attention(
     causal_offset = k.shape[2] - q.shape[2] if causal else None
 
     # pad KV once (shared by every q chunk): masked-out slots beyond nk
-    k, v, mask = _pad_kv_to_bucket(q, k, v, mask, bucket_size)
+    k, v, mask, kv_seg = _pad_kv_to_bucket(q, k, v, mask, kv_seg, bucket_size)
     # causal_offset stays computed from the real nk: pad keys sit at
     # j >= nk_real > i + offset for every real row, and the key mask
     # excludes them for fully-padded rows anyway.
@@ -416,44 +473,42 @@ def flash_attention(
         pad_q = (-nq) % cq
         if pad_q:
             q = jnp.pad(q, [(0, 0), (0, 0), (0, pad_q), (0, 0)])
+            if q_seg is not None:
+                # PAD_SEGMENT_ID matches nothing real; pad rows come out as
+                # garbage-but-finite and are sliced off below
+                q_seg = jnp.pad(q_seg, [(0, 0), (0, pad_q)],
+                                constant_values=PAD_SEGMENT_ID)
         b, h, _, d = q.shape
         nc = (nq + pad_q) // cq
         qs = jnp.moveaxis(q.reshape(b, h, nc, cq, d), 2, 0)  # (nc, b, h, cq, d)
+        xs = {"q": qs}
+        if q_seg is not None:
+            xs["qs"] = jnp.moveaxis(q_seg.reshape(b, nc, cq), 1, 0)
 
         if causal:
             # chunk rows start at start=i*cq, shifting the end-aligned band
-            offs = causal_offset + jnp.arange(nc, dtype=jnp.int32) * cq
+            xs["off"] = causal_offset + jnp.arange(nc, dtype=jnp.int32) * cq
 
-            def body(_, xs):
-                qc, off = xs
-                return None, _flash_attention_core(
-                    qc, k, v, mask, off, scale, bucket_size, window,
-                    softclamp_value,
-                )
+        def body(_, xs):
+            return None, _flash_attention_core(
+                xs["q"], k, v, mask, xs.get("qs"), kv_seg, xs.get("off"),
+                scale, bucket_size, window, softclamp_value,
+            )
 
-            _, outs = lax.scan(body, None, (qs, offs))
-        else:
-
-            def body(_, qc):
-                return None, _flash_attention_core(
-                    qc, k, v, mask, None, scale, bucket_size, window,
-                    softclamp_value,
-                )
-
-            _, outs = lax.scan(body, None, qs)
+        _, outs = lax.scan(body, None, xs)
 
         out = jnp.moveaxis(outs, 0, 2).reshape(b, h, nc * cq, d)
         return out[:, :, :nq] if pad_q else out
     return _flash_attention_core(
-        q, k, v, mask, causal_offset, scale, bucket_size, window,
-        softclamp_value,
+        q, k, v, mask, q_seg, kv_seg, causal_offset, scale, bucket_size,
+        window, softclamp_value,
     )
 
 
-def _pad_kv_to_bucket(q, k, v, mask, bucket_size):
+def _pad_kv_to_bucket(q, k, v, mask, kv_seg, bucket_size):
     nk = k.shape[2]
     if bucket_size is None or nk % bucket_size == 0:
-        return k, v, mask
+        return k, v, mask, kv_seg
     pad = bucket_size - nk % bucket_size
     widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
     k = jnp.pad(k, widths)
@@ -463,4 +518,7 @@ def _pad_kv_to_bucket(q, k, v, mask, bucket_size):
         mask = jnp.broadcast_to(mask, (q.shape[0], nk + pad))
     else:
         mask = jnp.pad(mask, [(0, 0), (0, pad)], constant_values=False)
-    return k, v, mask
+    if kv_seg is not None:
+        kv_seg = jnp.pad(kv_seg, [(0, 0), (0, pad)],
+                         constant_values=PAD_SEGMENT_ID)
+    return k, v, mask, kv_seg

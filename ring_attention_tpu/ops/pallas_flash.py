@@ -45,7 +45,9 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import EPSILON, MASK_VALUE
+import bisect
+
+from .attention import EPSILON, MASK_VALUE, normalize_segment_ids
 from ..utils import compat
 from ..utils.validate import check_attention_args
 
@@ -223,19 +225,23 @@ def _tile_closure(fn, kw, *args):
     return tile
 
 
-def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
+def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref,
+               qseg_ref=None, kseg_ref=None):
     """Per-element keep mask for a score tile, or None if unmasked.
 
     ``q_dim`` is the tile dimension holding query rows (0 in fwd/dq tiles,
     1 in the transposed dk/dv tiles); the other dimension holds key cols.
+    ``qseg_ref``/``kseg_ref`` are per-token document ids ((1, bq)/(1, bk))
+    for packed sequences — attention keeps same-document pairs only.
     """
     masked = kvm_ref is not None
-    if not (causal or masked):
+    segmented = qseg_ref is not None
+    if not (causal or masked or segmented):
         return None
-    rows = row0 + lax.broadcasted_iota(jnp.int32, shape, q_dim)
-    cols = col0 + lax.broadcasted_iota(jnp.int32, shape, 1 - q_dim)
     keep = None
     if causal:
+        rows = row0 + lax.broadcasted_iota(jnp.int32, shape, q_dim)
+        cols = col0 + lax.broadcasted_iota(jnp.int32, shape, 1 - q_dim)
         keep = cols <= rows + offs_ref[0]
         if windowed:
             keep = jnp.logical_and(keep, cols >= rows + offs_ref[1])
@@ -243,6 +249,14 @@ def _tile_keep(offs_ref, row0, col0, shape, q_dim, causal, windowed, kvm_ref):
         kvm = kvm_ref[0] != 0
         kvm = kvm[None, :] if q_dim == 0 else kvm[:, None]
         keep = kvm if keep is None else jnp.logical_and(keep, kvm)
+    if segmented:
+        qs, ks = qseg_ref[0], kseg_ref[0]
+        same = (
+            qs[:, None] == ks[None, :]
+            if q_dim == 0
+            else ks[:, None] == qs[None, :]
+        )
+        keep = same if keep is None else jnp.logical_and(keep, same)
     return keep
 
 
@@ -293,7 +307,8 @@ def _warn_demoted(kind: str, tiles: int, stacklevel: int = 4) -> None:
 
 def _compact_maps(h: int, hk: int, g: int):
     """Index maps for a compacted grid (bh, t): q-side blocks follow the
-    tile table's q entry, kv-side blocks its k entry (GQA head fold)."""
+    tile table's q entry, kv-side blocks its k entry (GQA head fold).
+    ``qm_map`` serves per-token q-side row vectors (segment ids)."""
 
     def q_map(bh, t, offs, tq, tk, tf):
         return (bh, tq[t], 0)
@@ -304,10 +319,13 @@ def _compact_maps(h: int, hk: int, g: int):
     def kvm_map(bh, t, offs, tq, tk, tf):
         return (bh // h, tk[t])
 
+    def qm_map(bh, t, offs, tq, tk, tf):
+        return (bh // h, tq[t])
+
     def k_out_map(bh, t, offs, tq, tk, tf):
         return (bh, tk[t], 0)
 
-    return q_map, kv_map, kvm_map, k_out_map
+    return q_map, kv_map, kvm_map, qm_map, k_out_map
 
 
 def _static_band(causal, windowed, causal_offset, window_lo):
@@ -348,12 +366,60 @@ def _normalize_hint(causal, windowed, causal_offset, window_lo, band_hint):
     return None
 
 
+def _check_doc_starts(doc_starts, nq: int, nk: int):
+    """Validate a declared packing layout: sorted unique int document start
+    offsets beginning at 0, shared by queries and keys (``nq == nk``)."""
+    if doc_starts is None:
+        return None
+    if nq != nk:
+        raise ValueError(
+            f"doc_starts declares one packing layout for q AND kv, which "
+            f"needs nq == nk, got ({nq}, {nk})"
+        )
+    ds = tuple(int(s) for s in doc_starts)
+    if not ds or ds[0] != 0 or list(ds) != sorted(set(ds)) or ds[-1] >= nk:
+        raise ValueError(
+            f"doc_starts must be sorted unique offsets starting at 0 and "
+            f"< {nk}, got {doc_starts!r}"
+        )
+    return ds
+
+
+def _docs_block_aligned(doc_starts, *block_sizes) -> bool:
+    """True when every document boundary lands on every block boundary —
+    the precondition for resolving the document mask at trace time."""
+    return all(s % b == 0 for s in doc_starts for b in block_sizes)
+
+
+def _doc_block_span(doc_starts, pos: int, block: int, n_blocks: int,
+                    total: int) -> tuple[int, int]:
+    """Inclusive block-index range of the document containing token ``pos``
+    (block-aligned layouts only: each block then lies in exactly one doc)."""
+    d = bisect.bisect_right(doc_starts, pos) - 1
+    start = doc_starts[d]
+    end = doc_starts[d + 1] if d + 1 < len(doc_starts) else total
+    return start // block, min((end - 1) // block, n_blocks - 1)
+
+
+def _doc_runtime_ids(doc_starts, n: int, batch: int) -> jax.Array:
+    """(b, n) int32 segment ids realizing a declared packing layout — the
+    in-kernel-mask fallback when the layout isn't block-aligned."""
+    starts = jnp.asarray(doc_starts, jnp.int32)
+    ids = jnp.searchsorted(starts, jnp.arange(n, dtype=jnp.int32),
+                           side="right") - 1
+    return jnp.broadcast_to(ids[None, :], (batch, n)).astype(jnp.int32)
+
+
 def _band_tile_count(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
-                     outer_is_q: bool) -> int:
+                     outer_is_q: bool, doc_starts=None) -> int:
     """Length of the :func:`_band_tables` tables, in closed form per outer
     row (no table construction — the SMEM cap check must not pay for
     building tables it is about to reject).  Pinned against the real
-    tables in ``tests/test_pallas_flash.py``."""
+    tables in ``tests/test_pallas_flash.py``.
+
+    ``doc_starts`` (block-aligned declared packing) intersects each outer
+    row's active range with its document's block span — the tile-count
+    arithmetic of the packed compact grid."""
     hi, _, lo, _ = hint
     outer_n = n_q_blocks if outer_is_q else n_k_blocks
     inner_n = n_k_blocks if outer_is_q else n_q_blocks
@@ -370,13 +436,22 @@ def _band_tile_count(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
             i_lo = max(-((-(col0 - hi - bq + 1)) // bq), 0)
             i_hi = (min((col0 + bk - 1 - lo) // bq, inner_n - 1)
                     if windowed else inner_n - 1)
+        if doc_starts is not None:
+            d_lo, d_hi = _doc_block_span(
+                doc_starts,
+                o * (bq if outer_is_q else bk),
+                bk if outer_is_q else bq,
+                inner_n,
+                n_q_blocks * bq,
+            )
+            i_lo, i_hi = max(i_lo, d_lo), min(i_hi, d_hi)
         n = i_hi - i_lo + 1
         count += n if n > 0 else 1  # empty rows get a dummy entry
     return count
 
 
 def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
-                 outer_is_q: bool):
+                 outer_is_q: bool, doc_starts=None):
     """(t_q, t_k, flags) int32 tables enumerating active band tiles.
 
     Iteration order is outer-major so the inner dimension carries the
@@ -394,11 +469,22 @@ def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
     tiles are interior.  Superset-only tiles are fully masked at run time;
     their contribution is wiped by the online-softmax rescale exactly like
     any fully-masked edge tile.
+
+    ``doc_starts`` (a block-boundary-aligned declared packing layout, see
+    :func:`_check_doc_starts`) additionally drops every cross-document
+    tile: each block then lies in exactly one document, so a tile is
+    active only when its q and k blocks share one — the packed-sequence
+    analogue of the causal skip, resolved at trace time into a smaller
+    grid rather than masked at run time.
     """
     hi_w, hi_i, lo_w, lo_i = hint
     tq, tk, tf = [], [], []
     outer_n = n_q_blocks if outer_is_q else n_k_blocks
     inner_n = n_k_blocks if outer_is_q else n_q_blocks
+
+    def doc_of(pos):
+        return bisect.bisect_right(doc_starts, pos) - 1
+
     for o in range(outer_n):
         start = len(tf)
         for i in range(inner_n):
@@ -407,6 +493,8 @@ def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
             active = col0 <= row0 + bq - 1 + hi_w
             if windowed:
                 active = active and col0 + bk - 1 >= row0 + lo_w
+            if active and doc_starts is not None:
+                active = doc_of(row0) == doc_of(col0)
             if active:
                 interior = col0 + bk - 1 <= row0 + hi_i and (
                     not windowed or col0 >= row0 + bq - 1 + lo_i
@@ -453,14 +541,17 @@ def _fwd_write(fused, outs, acc, m, l, exp2=False):
         l_ref[0] = l[:]
 
 
-def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
-                resume: bool, nk_blocks: int, **tile_kw):
+def _fwd_kernel(*refs, compact: bool, masked: bool, segmented: bool,
+                fused: bool, resume: bool, nk_blocks: int, **tile_kw):
     """Unified forward kernel.
 
     Ref layout (pallas passes scalar-prefetch, inputs, outputs, scratch
     positionally; the static flags say which are present):
       scalars: offs (+ tq/tk/tf tile tables when ``compact``)
       inputs:  q, k, v (+ kv mask when ``masked``)
+               (+ q/kv segment ids when ``segmented`` — packed sequences
+                masked in-kernel; a block-aligned declared layout resolves
+                them into the compact tables instead and ships no refs)
                (+ carry acc/m/l when ``resume`` — the running online-softmax
                 state of previous ring hops, continued in-kernel exactly
                 like the reference's ``LOAD_ACCUMULATED`` resume, ref
@@ -469,7 +560,8 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
       scratch: acc (bq, d) f32, m (bq, 1) f32, l (bq, 1) f32
     """
     bq, bk = tile_kw["bq"], tile_kw["bk"]
-    tile_kw = dict(tile_kw, masked=masked)  # consumed by _fwd_tile too
+    # consumed by _fwd_tile too
+    tile_kw = dict(tile_kw, masked=masked, segmented=segmented)
     if compact:
         offs_ref, tq_ref, tk_ref, tf_ref = refs[:4]
         idx = 4
@@ -480,6 +572,10 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
     idx += 3
     kvm_ref = refs[idx] if masked else None
     idx += 1 if masked else 0
+    qseg_ref = kseg_ref = None
+    if segmented:
+        qseg_ref, kseg_ref = refs[idx:idx + 2]
+        idx += 2
     carry_refs = None
     if resume:
         carry_refs = refs[idx:idx + 3]
@@ -514,7 +610,7 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, fused: bool,
             l[:] = jnp.zeros_like(l)
 
     tile = _tile_closure(_fwd_tile, tile_kw, offs_ref, q_ref, k_ref, v_ref,
-                         kvm_ref, acc, m, l, row0, col0)
+                         kvm_ref, qseg_ref, kseg_ref, acc, m, l, row0, col0)
     if compact:
         _dispatch_tile_compact(tf, tile)
     else:
@@ -563,9 +659,9 @@ def _online_update(s, v, acc, m, l, exp2=False):
     m[:] = m_new
 
 
-def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l, row0, col0,
-              *, scale, softclamp_value, causal, windowed, masked, bq, bk,
-              exp2=False):
+def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, qseg_ref, kseg_ref,
+              acc, m, l, row0, col0, *, scale, softclamp_value, causal,
+              windowed, masked, segmented, bq, bk, exp2=False):
     q = q_ref[0]
     k = k_ref[0]
     s = lax.dot_general(
@@ -579,6 +675,8 @@ def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, acc, m, l, row0, col0,
     keep = _tile_keep(
         offs_ref, row0, col0, (bq, bk), 0, causal, windowed,
         kvm_ref if masked else None,
+        qseg_ref if segmented else None,
+        kseg_ref if segmented else None,
     )
     if keep is not None:
         s = jnp.where(keep, s, MASK_VALUE)
@@ -598,7 +696,7 @@ def _flash_fwd_call(
     q, k, v, kv_mask, *,
     scale, causal_offset, window_lo, softclamp_value,
     block_q, block_k, band_hint, interpret, fused, carry=None,
-    exp2=None,
+    exp2=None, q_segment_ids=None, kv_segment_ids=None, doc_starts=None,
 ):
     """Shared forward launcher: one flash sweep over a KV span.
 
@@ -608,12 +706,20 @@ def _flash_fwd_call(
     resumes a previous sweep's ``(acc, m, l)`` state in-kernel (the
     reference's ``LOAD_ACCUMULATED``, ref ``triton_flash_attn.py:124-165``)
     — one HBM read of the carry instead of an XLA-side
-    :func:`merge_partials` that reads both operands and writes a third."""
+    :func:`merge_partials` that reads both operands and writes a third.
+
+    Packed sequences: ``q_segment_ids``/``kv_segment_ids`` mask
+    cross-document pairs in-kernel; ``doc_starts`` *declares* the packing
+    layout statically, and when it lands on block boundaries under a
+    compact causal grid the cross-document tiles are dropped from the grid
+    at trace time instead (no refs, no per-tile mask) — misaligned or
+    demoted layouts fall back to the in-kernel mask."""
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
     bq, bk = _block_sizes(nq, nk, block_q, block_k)
     interpret = _interpret_default() if interpret is None else interpret
+    doc_starts = _check_doc_starts(doc_starts, nq, nk)
 
     # power-of-two scale (every d = 4^k head dim, incl. the headline d=64
     # -> 1/8) folds into q exactly (exponent shift, bit-identical scores)
@@ -649,40 +755,64 @@ def _flash_fwd_call(
     hint = _normalize_hint(causal, windowed, causal_offset, window_lo,
                            band_hint)
     compact = hint is not None
+    # trace-time doc skip needs a compact grid AND a block-aligned layout
+    doc_tables = (
+        doc_starts
+        if compact and doc_starts is not None
+        and _docs_block_aligned(doc_starts, bq, bk)
+        else None
+    )
+
+    if compact:
+        tiles = _band_tile_count(
+            nq // bq, nk // bk, bq, bk, hint, windowed, outer_is_q=True,
+            doc_starts=doc_tables,
+        )
+        compact = tiles <= _MAX_COMPACT_TILES
+        if not compact:
+            _warn_demoted("fwd", tiles)
+            doc_tables = None
+
+    if doc_tables is not None:
+        # the tables carry the whole document mask: ship no segment refs
+        q_segment_ids = kv_segment_ids = None
+    elif doc_starts is not None and q_segment_ids is None:
+        # misaligned/demoted declared layout: realize it as runtime ids
+        q_segment_ids = kv_segment_ids = _doc_runtime_ids(doc_starts, nq, b)
+    segmented = q_segment_ids is not None
+
     common = dict(
         scale=scale,
         softclamp_value=softclamp_value,
         causal=causal,
         windowed=windowed,
         masked=masked,
+        segmented=segmented,
         bq=bq,
         bk=bk,
         exp2=exp2,
     )
 
     if compact:
-        tiles = _band_tile_count(
-            nq // bq, nk // bk, bq, bk, hint, windowed, outer_is_q=True
-        )
-        compact = tiles <= _MAX_COMPACT_TILES
-        if not compact:
-            _warn_demoted("fwd", tiles)
-
-    if compact:
         tq_a, tk_a, tf_a = (
             jnp.asarray(t)
             for t in _band_tables(nq // bq, nk // bk, bq, bk, hint,
-                                  windowed, outer_is_q=True)
+                                  windowed, outer_is_q=True,
+                                  doc_starts=doc_tables)
         )
-        q, k, v, kv_mask, offs, tq_a, tk_a, tf_a = _unify_vma(
-            q, k, v, kv_mask, offs, tq_a, tk_a, tf_a
+        (q, k, v, kv_mask, q_segment_ids, kv_segment_ids, offs, tq_a, tk_a,
+         tf_a) = _unify_vma(
+            q, k, v, kv_mask, q_segment_ids, kv_segment_ids, offs, tq_a,
+            tk_a, tf_a
         )
         scalars = (offs, tq_a, tk_a, tf_a)
         grid = (b * h, tq_a.shape[0])
-        q_map, kv_map, kvm_map, _ = _compact_maps(h, hk, g)
+        q_map, kv_map, kvm_map, qm_map, _ = _compact_maps(h, hk, g)
         semantics = ("parallel", "arbitrary")
     else:
-        q, k, v, kv_mask, offs = _unify_vma(q, k, v, kv_mask, offs)
+        q, k, v, kv_mask, q_segment_ids, kv_segment_ids, offs = _unify_vma(
+            q, k, v, kv_mask, q_segment_ids, kv_segment_ids, offs
+        )
         scalars = (offs,)
         grid = (b * h, nq // bq, nk // bk)
 
@@ -694,6 +824,9 @@ def _flash_fwd_call(
 
         def kvm_map(bh, qi, ki, *_):
             return (bh // h, ki)
+
+        def qm_map(bh, qi, ki, *_):
+            return (bh // h, qi)
 
         # batch*head and q-block grid dims are independent (megacore can
         # split them); the kv dim carries the online-softmax state
@@ -722,6 +855,15 @@ def _flash_fwd_call(
         kvm = kv_mask.astype(jnp.int8)
         in_specs.append(pl.BlockSpec((1, bk), kvm_map, memory_space=pltpu.VMEM))
         inputs.append(kvm)
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, bq), qm_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), kvm_map, memory_space=pltpu.VMEM),
+        ]
+        inputs += [
+            q_segment_ids.astype(jnp.int32),
+            kv_segment_ids.astype(jnp.int32),
+        ]
     if resume:
         c_acc, c_m, c_l = (_unify_vma(x, q)[0] for x in carry)
         inputs += [
@@ -805,6 +947,8 @@ def pallas_flash_partials(
     carry: FlashPartials | None = None,
     interpret: bool | None = None,
     exp2: bool | None = None,
+    segment_ids=None,
+    doc_starts: tuple[int, ...] | None = None,
 ) -> FlashPartials:
     """One flash sweep over a KV span, returning mergeable partials.
 
@@ -818,13 +962,22 @@ def pallas_flash_partials(
     the ``RING_ATTN_EXP2`` env var, captured at trace time — see
     :func:`_exp2_default`); the emitted partials are natural-basis either
     way, so sweeps of different bases merge exactly.
+
+    ``segment_ids`` (a ``(b, n)`` array or ``(q_ids, kv_ids)`` pair) masks
+    cross-document pairs for packed sequences; ``doc_starts`` declares the
+    packing statically so a block-aligned layout drops cross-document
+    tiles from the compact grid at trace time (``docs/packing.md``).
     """
+    q_seg, kv_seg = normalize_segment_ids(
+        segment_ids, q, k, "pallas_flash_partials"
+    )
     return _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
         band_hint=band_hint, interpret=interpret, fused=False, carry=carry,
-        exp2=exp2,
+        exp2=exp2, q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        doc_starts=doc_starts,
     )
 
 
@@ -844,6 +997,8 @@ def pallas_flash_fused(
     carry: FlashPartials | None = None,
     interpret: bool | None = None,
     exp2: bool | None = None,
+    segment_ids=None,
+    doc_starts: tuple[int, ...] | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Single-span forward with normalization fused into the final kernel
     write: returns ``(out in q.dtype, lse f32)`` directly.
@@ -866,12 +1021,16 @@ def pallas_flash_fused(
         raise ValueError(
             "pallas_flash_fused: band_hint needs a carry (see docstring)"
         )
+    q_seg, kv_seg = normalize_segment_ids(
+        segment_ids, q, k, "pallas_flash_fused"
+    )
     return _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
         band_hint=band_hint, interpret=interpret, fused=True, carry=carry,
-        exp2=exp2,
+        exp2=exp2, q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        doc_starts=doc_starts,
     )
 
 
@@ -1213,49 +1372,88 @@ def finalize_partials(p: FlashPartials) -> tuple[jax.Array, jax.Array]:
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dkv_kernel(
-    offs_ref,
-    q_ref,  # (1, bq, d)
-    do_ref,  # (1, bq, d)
-    lse_ref,  # (1, bq, 1)
-    delta_ref,  # (1, bq, 1)
-    k_ref,  # (1, bk, d)
-    v_ref,  # (1, bk, d)
-    kvm_ref,  # (1, bk) or None
-    dk_ref,  # (1, bk, d) f32
-    dv_ref,  # (1, bk, d) f32
-    dk,  # scratch (bk, d) f32
-    dv,  # scratch (bk, d) f32
-    *,
-    nq_blocks: int,
-    **tile_kw,
-):
-    bq, bk = tile_kw["bq"], tile_kw["bk"]
-    qi = pl.program_id(2)
+def _bwd_parse_refs(refs, compact, masked, segmented, bq, bk):
+    """Shared ref/position parsing for both backward kernels.
 
-    @pl.when(qi == 0)
+    Ref layout (pallas passes scalar-prefetch, inputs, outputs, scratch
+    positionally; the static flags say which are present):
+      scalars: offs (+ tq/tk/tf tile tables when ``compact``)
+      inputs:  q, do, lse, delta, k, v (+ kv mask when ``masked``)
+               (+ q/kv segment ids when ``segmented``)
+      then kernel-specific outputs + scratch (the ``rest`` return).
+
+    Returns ``(offs_ref, tiles, kvm_ref, qseg_ref, kseg_ref, first, last,
+    row0, col0, tf, rest)`` where ``first``/``last`` bound the inner
+    (accumulator-carrying) dimension, ``tiles = (q, do, lse, delta, k,
+    v)`` refs, and ``tf`` is the compact grid's per-tile flag word (None
+    on rectangular grids, whose callers derive first/last/row0/col0 from
+    ``pl.program_id`` instead — those five slots come back as None here).
+    """
+    if compact:
+        offs_ref, tq_ref, tk_ref, tf_ref = refs[:4]
+        idx = 4
+        t = pl.program_id(1)
+        tf = tf_ref[t]
+        first = (tf & _TF_FIRST) != 0
+        last = (tf & _TF_LAST) != 0
+        row0, col0 = tq_ref[t] * bq, tk_ref[t] * bk
+        tf_or_none = tf
+    else:
+        offs_ref = refs[0]
+        idx = 1
+        first = last = row0 = col0 = tf_or_none = None  # caller fills in
+    tiles = refs[idx:idx + 6]
+    idx += 6
+    kvm_ref = refs[idx] if masked else None
+    idx += 1 if masked else 0
+    qseg_ref = kseg_ref = None
+    if segmented:
+        qseg_ref, kseg_ref = refs[idx:idx + 2]
+        idx += 2
+    return (offs_ref, tiles, kvm_ref, qseg_ref, kseg_ref, first, last,
+            row0, col0, tf_or_none, refs[idx:])
+
+
+def _bwd_dkv_kernel(*refs, compact: bool, masked: bool, segmented: bool,
+                    nq_blocks: int, **tile_kw):
+    """dk/dv pass: the grid holds a KV block and streams query blocks
+    (rect grid ``(bh, ki, qi)``; compact grid k-major tile tables)."""
+    bq, bk = tile_kw["bq"], tile_kw["bk"]
+    tile_kw = dict(tile_kw, masked=masked, segmented=segmented)
+    (offs_ref, tiles, kvm_ref, qseg_ref, kseg_ref, first, last, row0, col0,
+     tf, rest) = _bwd_parse_refs(refs, compact, masked, segmented, bq, bk)
+    if not compact:
+        ki, qi = pl.program_id(1), pl.program_id(2)
+        first = qi == 0
+        last = qi == nq_blocks - 1
+        row0, col0 = qi * bq, ki * bk
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref = tiles
+    dk_ref, dv_ref, dk, dv = rest
+
+    @pl.when(first)
     def _init():
         dk[:] = jnp.zeros_like(dk)
         dv[:] = jnp.zeros_like(dv)
 
-    ki = pl.program_id(1)
-    row0 = qi * bq
-    col0 = ki * bk
-
     tile = _tile_closure(_dkv_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
-                         delta_ref, k_ref, v_ref, kvm_ref, dk, dv, row0, col0)
-    _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
-                   tile_kw["windowed"], tile)
+                         delta_ref, k_ref, v_ref, kvm_ref, qseg_ref, kseg_ref,
+                         dk, dv, row0, col0)
+    if compact:
+        _dispatch_tile_compact(tf, tile)
+    else:
+        _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
+                       tile_kw["windowed"], tile)
 
-    @pl.when(qi == nq_blocks - 1)
+    @pl.when(last)
     def _write():
         dk_ref[0] = dk[:]
         dv_ref[0] = dv[:]
 
 
 def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-              kvm_ref, dk, dv, row0, col0, *, scale, softclamp_value,
-              causal, windowed, masked, bq, bk, exp2=False):
+              kvm_ref, qseg_ref, kseg_ref, dk, dv, row0, col0, *, scale,
+              softclamp_value, causal, windowed, masked, segmented, bq, bk,
+              exp2=False):
     kb = k_ref[0]
     qb = q_ref[0]
     # sT: (bk, bq) = k . q^T (contract d on both)
@@ -1272,6 +1470,8 @@ def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     keep = _tile_keep(
         offs_ref, row0, col0, (bk, bq), 1, causal, windowed,
         kvm_ref if masked else None,
+        qseg_ref if segmented else None,
+        kseg_ref if segmented else None,
     )
     if keep is not None:
         pT = jnp.where(keep, pT, 0.0)
@@ -1297,85 +1497,44 @@ def _dkv_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     )
 
 
-def _bwd_dkv_kernel_compact(
-    offs_ref, tq_ref, tk_ref, tf_ref,
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, kvm_ref,
-    dk_ref, dv_ref, dk, dv,
-    **tile_kw,
-):
+def _bwd_dq_kernel(*refs, compact: bool, masked: bool, segmented: bool,
+                   nk_blocks: int, **tile_kw):
+    """dq pass: the grid holds a Q block and streams KV blocks
+    (rect grid ``(bh, qi, ki)``; compact grid q-major tile tables)."""
     bq, bk = tile_kw["bq"], tile_kw["bk"]
-    t = pl.program_id(1)
-    tf = tf_ref[t]
+    tile_kw = dict(tile_kw, masked=masked, segmented=segmented)
+    (offs_ref, tiles, kvm_ref, qseg_ref, kseg_ref, first, last, row0, col0,
+     tf, rest) = _bwd_parse_refs(refs, compact, masked, segmented, bq, bk)
+    if not compact:
+        qi, ki = pl.program_id(1), pl.program_id(2)
+        first = ki == 0
+        last = ki == nk_blocks - 1
+        row0, col0 = qi * bq, ki * bk
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref = tiles
+    dq_ref, dq = rest
 
-    @pl.when((tf & _TF_FIRST) != 0)
-    def _init():
-        dk[:] = jnp.zeros_like(dk)
-        dv[:] = jnp.zeros_like(dv)
-
-    tile = _tile_closure(_dkv_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
-                         delta_ref, k_ref, v_ref, kvm_ref, dk, dv,
-                         tq_ref[t] * bq, tk_ref[t] * bk)
-    _dispatch_tile_compact(tf, tile)
-
-    @pl.when((tf & _TF_LAST) != 0)
-    def _write():
-        dk_ref[0] = dk[:]
-        dv_ref[0] = dv[:]
-
-
-def _bwd_dkv_kernel_compact_nomask(offs_ref, tq_ref, tk_ref, tf_ref,
-                                   q_ref, do_ref, lse_ref, delta_ref,
-                                   k_ref, v_ref, dk_ref, dv_ref, dk, dv, **kw):
-    _bwd_dkv_kernel_compact(offs_ref, tq_ref, tk_ref, tf_ref,
-                            q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                            None, dk_ref, dv_ref, dk, dv, **kw)
-
-
-def _bwd_dkv_kernel_nomask(offs_ref, q_ref, do_ref, lse_ref, delta_ref,
-                           k_ref, v_ref, dk_ref, dv_ref, dk, dv, **kw):
-    _bwd_dkv_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                    None, dk_ref, dv_ref, dk, dv, **kw)
-
-
-def _bwd_dq_kernel(
-    offs_ref,
-    q_ref,  # (1, bq, d)
-    do_ref,  # (1, bq, d)
-    lse_ref,  # (1, bq, 1)
-    delta_ref,  # (1, bq, 1)
-    k_ref,  # (1, bk, d)
-    v_ref,  # (1, bk, d)
-    kvm_ref,  # (1, bk) or None
-    dq_ref,  # (1, bq, d) f32
-    dq,  # scratch (bq, d) f32
-    *,
-    nk_blocks: int,
-    **tile_kw,
-):
-    bq, bk = tile_kw["bq"], tile_kw["bk"]
-    ki = pl.program_id(2)
-
-    @pl.when(ki == 0)
+    @pl.when(first)
     def _init():
         dq[:] = jnp.zeros_like(dq)
 
-    qi = pl.program_id(1)
-    row0 = qi * bq
-    col0 = ki * bk
-
     tile = _tile_closure(_dq_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
-                         delta_ref, k_ref, v_ref, kvm_ref, dq, row0, col0)
-    _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
-                   tile_kw["windowed"], tile)
+                         delta_ref, k_ref, v_ref, kvm_ref, qseg_ref, kseg_ref,
+                         dq, row0, col0)
+    if compact:
+        _dispatch_tile_compact(tf, tile)
+    else:
+        _dispatch_tile(offs_ref, row0, col0, bq, bk, tile_kw["causal"],
+                       tile_kw["windowed"], tile)
 
-    @pl.when(ki == nk_blocks - 1)
+    @pl.when(last)
     def _write():
         dq_ref[0] = dq[:]
 
 
 def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-             kvm_ref, dq, row0, col0, *, scale, softclamp_value, causal,
-             windowed, masked, bq, bk, exp2=False):
+             kvm_ref, qseg_ref, kseg_ref, dq, row0, col0, *, scale,
+             softclamp_value, causal, windowed, masked, segmented, bq, bk,
+             exp2=False):
     qb = q_ref[0]
     kb = k_ref[0]
     s = lax.dot_general(
@@ -1390,6 +1549,8 @@ def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     keep = _tile_keep(
         offs_ref, row0, col0, (bq, bk), 0, causal, windowed,
         kvm_ref if masked else None,
+        qseg_ref if segmented else None,
+        kseg_ref if segmented else None,
     )
     if keep is not None:
         p = jnp.where(keep, p, 0.0)
@@ -1408,44 +1569,6 @@ def _dq_tile(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-
-
-def _bwd_dq_kernel_compact(
-    offs_ref, tq_ref, tk_ref, tf_ref,
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, kvm_ref,
-    dq_ref, dq,
-    **tile_kw,
-):
-    bq, bk = tile_kw["bq"], tile_kw["bk"]
-    t = pl.program_id(1)
-    tf = tf_ref[t]
-
-    @pl.when((tf & _TF_FIRST) != 0)
-    def _init():
-        dq[:] = jnp.zeros_like(dq)
-
-    tile = _tile_closure(_dq_tile, tile_kw, offs_ref, q_ref, do_ref, lse_ref,
-                         delta_ref, k_ref, v_ref, kvm_ref, dq,
-                         tq_ref[t] * bq, tk_ref[t] * bk)
-    _dispatch_tile_compact(tf, tile)
-
-    @pl.when((tf & _TF_LAST) != 0)
-    def _write():
-        dq_ref[0] = dq[:]
-
-
-def _bwd_dq_kernel_compact_nomask(offs_ref, tq_ref, tk_ref, tf_ref,
-                                  q_ref, do_ref, lse_ref, delta_ref,
-                                  k_ref, v_ref, dq_ref, dq, **kw):
-    _bwd_dq_kernel_compact(offs_ref, tq_ref, tk_ref, tf_ref,
-                           q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                           None, dq_ref, dq, **kw)
-
-
-def _bwd_dq_kernel_nomask(offs_ref, q_ref, do_ref, lse_ref, delta_ref,
-                          k_ref, v_ref, dq_ref, dq, **kw):
-    _bwd_dq_kernel(offs_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                   None, dq_ref, dq, **kw)
 
 
 def pallas_flash_backward(
@@ -1470,6 +1593,8 @@ def pallas_flash_backward(
     band_hint: tuple[int, int, int, int] | None = None,
     interpret: bool | None = None,
     exp2: bool | None = None,
+    segment_ids=None,
+    doc_starts: tuple[int, ...] | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-pass flash backward. Returns (dq, dk, dv), all f32, dk/dv with
     ``hk`` heads (GQA group-summed).
@@ -1477,10 +1602,20 @@ def pallas_flash_backward(
     The two passes stream in opposite directions (dk/dv holds KV and
     streams queries; dq holds Q and streams KV), so their optimal tile
     shapes differ; ``block_*_dkv`` / ``block_*_dq`` override the shared
-    ``block_q`` / ``block_k`` per pass."""
+    ``block_q`` / ``block_k`` per pass.
+
+    ``segment_ids``/``doc_starts`` mirror the forward (packed sequences):
+    cross-document terms drop out of ``p`` in both passes, and a
+    block-aligned declared layout drops cross-document tiles from each
+    pass's compact grid at trace time (checked against that pass's block
+    sizes independently)."""
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
+    q_seg, kv_seg = normalize_segment_ids(
+        segment_ids, q, k, "pallas_flash_backward"
+    )
+    doc_starts = _check_doc_starts(doc_starts, nq, nk)
 
     # power-of-two scale folds into q here too (exact, see _flash_fwd_call):
     # s/sT recompute unchanged, dk = dsT·q̃ absorbs the factor exactly
@@ -1537,40 +1672,66 @@ def pallas_flash_backward(
     hint = _normalize_hint(causal, windowed, causal_offset, window_lo,
                            band_hint)
     # each pass has its own grid/tables: the SMEM cap demotes them
-    # independently (per-pass block sizes can put one over, not the other)
+    # independently (per-pass block sizes can put one over, not the other),
+    # and the trace-time doc skip needs the layout aligned to that pass's
+    # own block sizes
     compact_dkv = compact_dq = False
+    docs_dkv = docs_dq = None
     dkv_tabs = dq_tabs = []
     if hint is not None:
+        if doc_starts is not None:
+            if _docs_block_aligned(doc_starts, bq1, bk1):
+                docs_dkv = doc_starts
+            if _docs_block_aligned(doc_starts, bq2, bk2):
+                docs_dq = doc_starts
         tiles_dkv = _band_tile_count(
-            nq // bq1, nk // bk1, bq1, bk1, hint, windowed, outer_is_q=False
+            nq // bq1, nk // bk1, bq1, bk1, hint, windowed, outer_is_q=False,
+            doc_starts=docs_dkv,
         )
         tiles_dq = _band_tile_count(
-            nq // bq2, nk // bk2, bq2, bk2, hint, windowed, outer_is_q=True
+            nq // bq2, nk // bk2, bq2, bk2, hint, windowed, outer_is_q=True,
+            doc_starts=docs_dq,
         )
         compact_dkv = tiles_dkv <= _MAX_COMPACT_TILES
         compact_dq = tiles_dq <= _MAX_COMPACT_TILES
         if not compact_dkv:
             _warn_demoted("bwd dk/dv", tiles_dkv, stacklevel=3)
+            docs_dkv = None
         if not compact_dq:
             _warn_demoted("bwd dq", tiles_dq, stacklevel=3)
+            docs_dq = None
         if compact_dkv:
             dkv_tabs = [
                 jnp.asarray(t)
                 for t in _band_tables(nq // bq1, nk // bk1, bq1, bk1, hint,
-                                      windowed, outer_is_q=False)
+                                      windowed, outer_is_q=False,
+                                      doc_starts=docs_dkv)
             ]
         if compact_dq:
             dq_tabs = [
                 jnp.asarray(t)
                 for t in _band_tables(nq // bq2, nk // bk2, bq2, bk2, hint,
-                                      windowed, outer_is_q=True)
+                                      windowed, outer_is_q=True,
+                                      doc_starts=docs_dq)
             ]
+    # runtime segment refs are needed by any pass whose tables don't carry
+    # the document mask; a pass whose tables DO carry it skips the refs
+    if doc_starts is not None and q_seg is None and not (
+        docs_dkv is not None and docs_dq is not None
+    ):
+        q_seg = kv_seg = _doc_runtime_ids(doc_starts, nq, b)
+    seg_dkv = q_seg is not None and docs_dkv is None
+    seg_dq = q_seg is not None and docs_dq is None
     unified = _unify_vma(
-        q, k, v, do, lse, delta, kv_mask, offs, *dkv_tabs, *dq_tabs
+        q, k, v, do, lse, delta, kv_mask, q_seg, kv_seg, offs,
+        *dkv_tabs, *dq_tabs
     )
-    q, k, v, do, lse, delta, kv_mask, offs = unified[:8]
-    dkv_tabs = unified[8:8 + len(dkv_tabs)]
-    dq_tabs = unified[8 + len(dkv_tabs):]
+    q, k, v, do, lse, delta, kv_mask, q_seg, kv_seg, offs = unified[:10]
+    dkv_tabs = unified[10:10 + len(dkv_tabs)]
+    dq_tabs = unified[10 + len(dkv_tabs):]
+    if q_seg is not None:
+        q_seg = q_seg.astype(jnp.int32)
+        kv_seg = kv_seg.astype(jnp.int32)
     qr = q.reshape(b * h, nq, d)
     dor = do.reshape(b * h, nq, d).astype(q.dtype)
     lser = lse.reshape(b * h, nq, 1)
@@ -1597,12 +1758,13 @@ def pallas_flash_backward(
         kvh = (bh % h) // g
         return (b_idx * hk + kvh, ki, 0)
 
+    # masked/segmented ride the kernel partials per pass (the two passes
+    # can differ on segmented when only one pass's tables carry the docs)
     common1 = dict(
         scale=scale,
         softclamp_value=softclamp_value,
         causal=causal,
         windowed=windowed,
-        masked=masked,
         bq=bq1,
         bk=bk1,
         exp2=exp2,
@@ -1611,27 +1773,28 @@ def pallas_flash_backward(
 
     # ---- dk/dv pass: grid (bh, k blocks, q blocks), or compacted band ----
     if compact_dkv:
-        dkv_q_map, dkv_kv_map, dkv_kvm_map, dkv_out_map = _compact_maps(h, hk, g)
+        (dkv_q_map, dkv_kv_map, dkv_kvm_map, dkv_qsm_map,
+         dkv_out_map) = _compact_maps(h, hk, g)
         dkv_scalars = (offs, *dkv_tabs)
         dkv_grid = (b * h, dkv_tabs[0].shape[0])
-        dkv_kernel = functools.partial(
-            _bwd_dkv_kernel_compact if masked else _bwd_dkv_kernel_compact_nomask,
-            **common1,
-        )
         dkv_semantics = ("parallel", "arbitrary")
     else:
         dkv_q_map = q_map_inner
         dkv_kv_map = kv_map_outer
         dkv_kvm_map = lambda bh, ki, qi, *_: (bh // h, ki)  # noqa: E731
+        dkv_qsm_map = lambda bh, ki, qi, *_: (bh // h, qi)  # noqa: E731
         dkv_out_map = lambda bh, ki, qi, *_: (bh, ki, 0)  # noqa: E731
         dkv_scalars = (offs,)
         dkv_grid = (b * h, nk // bk1, nq // bq1)
-        dkv_kernel = functools.partial(
-            _bwd_dkv_kernel if masked else _bwd_dkv_kernel_nomask,
-            nq_blocks=nq // bq1,
-            **common1,
-        )
         dkv_semantics = ("parallel", "parallel", "arbitrary")
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel,
+        compact=compact_dkv,
+        masked=masked,
+        segmented=seg_dkv,
+        nq_blocks=nq // bq1,
+        **common1,
+    )
 
     in_specs = [
         pl.BlockSpec((1, bq1, d), dkv_q_map, memory_space=pltpu.VMEM),
@@ -1648,6 +1811,12 @@ def pallas_flash_backward(
             pl.BlockSpec((1, bk1), dkv_kvm_map, memory_space=pltpu.VMEM)
         )
         inputs.append(kvm)
+    if seg_dkv:
+        in_specs += [
+            pl.BlockSpec((1, bq1), dkv_qsm_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk1), dkv_kvm_map, memory_space=pltpu.VMEM),
+        ]
+        inputs += [q_seg, kv_seg]
 
     dk_h, dv_h = pl.pallas_call(
         dkv_kernel,
@@ -1684,26 +1853,26 @@ def pallas_flash_backward(
 
     # ---- dq pass: grid (bh, q blocks, k blocks), or compacted band ----
     if compact_dq:
-        dq_q_map, dq_kv_map, dq_kvm_map, _ = _compact_maps(h, hk, g)
+        dq_q_map, dq_kv_map, dq_kvm_map, dq_qsm_map, _ = _compact_maps(h, hk, g)
         dq_scalars = (offs, *dq_tabs)
         dq_grid = (b * h, dq_tabs[0].shape[0])
-        dq_kernel = functools.partial(
-            _bwd_dq_kernel_compact if masked else _bwd_dq_kernel_compact_nomask,
-            **common2,
-        )
         dq_semantics = ("parallel", "arbitrary")
     else:
         dq_q_map = q_map
         dq_kv_map = kv_map_inner
         dq_kvm_map = lambda bh, qi, ki, *_: (bh // h, ki)  # noqa: E731
+        dq_qsm_map = lambda bh, qi, ki, *_: (bh // h, qi)  # noqa: E731
         dq_scalars = (offs,)
         dq_grid = (b * h, nq // bq2, nk // bk2)
-        dq_kernel = functools.partial(
-            _bwd_dq_kernel if masked else _bwd_dq_kernel_nomask,
-            nk_blocks=nk // bk2,
-            **common2,
-        )
         dq_semantics = ("parallel", "parallel", "arbitrary")
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel,
+        compact=compact_dq,
+        masked=masked,
+        segmented=seg_dq,
+        nk_blocks=nk // bk2,
+        **common2,
+    )
 
     in_specs = [
         pl.BlockSpec((1, bq2, d), dq_q_map, memory_space=pltpu.VMEM),
@@ -1719,6 +1888,12 @@ def pallas_flash_backward(
         in_specs.append(
             pl.BlockSpec((1, bk2), dq_kvm_map, memory_space=pltpu.VMEM)
         )
+    if seg_dq:
+        in_specs += [
+            pl.BlockSpec((1, bq2), dq_qsm_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk2), dq_kvm_map, memory_space=pltpu.VMEM),
+        ]
+        inputs += [q_seg, kv_seg]
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -1746,26 +1921,29 @@ def pallas_flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _pallas_flash_core(q, k, v, kv_mask, scale, causal_offset, window,
-                       softclamp_value, interpret, exp2):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _pallas_flash_core(q, k, v, kv_mask, q_seg, kv_seg, scale, causal_offset,
+                       window, softclamp_value, interpret, exp2, doc_starts):
     out, _ = _pallas_flash_fwd_impl(
-        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value,
-        interpret, exp2
+        q, k, v, kv_mask, q_seg, kv_seg, scale, causal_offset, window,
+        softclamp_value, interpret, exp2, doc_starts
     )
     return out
 
 
-def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
-                           softclamp_value, interpret, exp2):
+def _pallas_flash_fwd_impl(q, k, v, kv_mask, q_seg, kv_seg, scale,
+                           causal_offset, window, softclamp_value, interpret,
+                           exp2, doc_starts):
     window_lo = causal_offset - (window - 1) if window is not None else None
     # fused finalize: the kernel writes normalized q.dtype output + lse, so
     # the f32 (acc, m, l) triple never touches HBM (512 MB saved per call
     # at seq 262144, h=8, d=64)
-    out, lse = pallas_flash_fused(
+    out, lse = _flash_fwd_call(
         q, k, v, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
-        softclamp_value=softclamp_value, interpret=interpret, exp2=exp2,
+        softclamp_value=softclamp_value, block_q=None, block_k=None,
+        band_hint=None, interpret=interpret, fused=True, exp2=exp2,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg, doc_starts=doc_starts,
     )
     # named residuals: lets a remat policy save (out, lse) so the backward's
     # residual recompute elides this kernel (see parallel/ring.py, same names)
@@ -1774,26 +1952,30 @@ def _pallas_flash_fwd_impl(q, k, v, kv_mask, scale, causal_offset, window,
     return out, lse
 
 
-def _pallas_flash_core_fwd(q, k, v, kv_mask, scale, causal_offset, window,
-                           softclamp_value, interpret, exp2):
+def _pallas_flash_core_fwd(q, k, v, kv_mask, q_seg, kv_seg, scale,
+                           causal_offset, window, softclamp_value, interpret,
+                           exp2, doc_starts):
     out, lse = _pallas_flash_fwd_impl(
-        q, k, v, kv_mask, scale, causal_offset, window, softclamp_value,
-        interpret, exp2
+        q, k, v, kv_mask, q_seg, kv_seg, scale, causal_offset, window,
+        softclamp_value, interpret, exp2, doc_starts
     )
-    return out, (q, k, v, kv_mask, out, lse)
+    return out, (q, k, v, kv_mask, q_seg, kv_seg, out, lse)
 
 
 def _pallas_flash_core_bwd(scale, causal_offset, window, softclamp_value,
-                           interpret, exp2, res, do):
-    q, k, v, kv_mask, out, lse = res
+                           interpret, exp2, doc_starts, res, do):
+    q, k, v, kv_mask, q_seg, kv_seg, out, lse = res
     window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
     dq, dk, dv = pallas_flash_backward(
         do, q, k, v, lse, delta, kv_mask,
         scale=scale, causal_offset=causal_offset, window_lo=window_lo,
         softclamp_value=softclamp_value, interpret=interpret, exp2=exp2,
+        segment_ids=(None if q_seg is None else (q_seg, kv_seg)),
+        doc_starts=doc_starts,
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None, None)
 
 
 _pallas_flash_core.defvjp(_pallas_flash_core_fwd, _pallas_flash_core_bwd)
@@ -1812,6 +1994,8 @@ def pallas_flash_attention(
     head_chunks: int | None = None,
     interpret: bool | None = None,
     exp2: bool | None = None,
+    segment_ids=None,
+    doc_starts: tuple[int, ...] | None = None,
 ) -> jax.Array:
     """Exact flash attention on the Pallas TPU kernel path (GQA-aware).
 
@@ -1827,8 +2011,20 @@ def pallas_flash_attention(
     remote-compile relay) still runs at full rate, paying only c-1 extra
     kernel launches.  Heads are embarrassingly parallel in attention, so
     outputs are bit-identical to the unsplit launch.
+
+    ``segment_ids`` (``(b, n)`` array or ``(q_ids, kv_ids)`` pair) masks
+    cross-document attention for packed sequences — fwd and bwd.
+    ``doc_starts`` is the *static* layout declaration: when its boundaries
+    land on the kernel block sizes, cross-document tiles leave the compact
+    causal grid at trace time (skipped, not masked); see
+    ``docs/packing.md`` for the contract.
     """
     check_attention_args("pallas_flash_attention", q, k, v, mask)
+    q_seg, kv_seg = normalize_segment_ids(
+        segment_ids, q, k, "pallas_flash_attention"
+    )
+    if doc_starts is not None:
+        doc_starts = _check_doc_starts(doc_starts, q.shape[2], k.shape[2])
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if window is not None:
@@ -1854,13 +2050,13 @@ def pallas_flash_attention(
                 q[:, i * hq_c:(i + 1) * hq_c],
                 k[:, i * hk_c:(i + 1) * hk_c],
                 v[:, i * hk_c:(i + 1) * hk_c],
-                mask, scale, causal_offset, window, softclamp_value,
-                interpret, exp2,
+                mask, q_seg, kv_seg, scale, causal_offset, window,
+                softclamp_value, interpret, exp2, doc_starts,
             )
             for i in range(head_chunks)
         ]
         return jnp.concatenate(outs, axis=1)
     return _pallas_flash_core(
-        q, k, v, mask, scale, causal_offset, window, softclamp_value,
-        interpret, exp2,
+        q, k, v, mask, q_seg, kv_seg, scale, causal_offset, window,
+        softclamp_value, interpret, exp2, doc_starts,
     )

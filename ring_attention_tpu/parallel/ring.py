@@ -61,6 +61,7 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..utils import compat
+from ..ops.attention import normalize_segment_ids, segments_overlap
 from ..ops.flash import (
     attend_blocks,
     finalize,
@@ -110,9 +111,12 @@ def _streams(bidirectional: bool, n_local: int) -> list[tuple[int, int, int]]:
     return [(1, 0, half), (-1, half, half)]
 
 
-def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask):
-    """Streams + their sliced KV stacks and mask shards (fwd and bwd share
-    this so the fallback condition and slice bounds can never diverge).
+def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask,
+                  segment_ids=None):
+    """Streams + their sliced KV stacks, mask shards, and kv segment-id
+    shards (fwd and bwd share this so the fallback condition and slice
+    bounds can never diverge).  Segment ids circulate exactly like the
+    mask: the queries keep the local ids, the kv ids ride the ring.
 
     Limited passes never see the reverse stream's useful origins in time
     (see the ``bidirectional`` docstring) — run unidirectional instead.
@@ -127,7 +131,12 @@ def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask):
         if kv_mask is not None
         else ()
     )
-    return streams, kvs, masks
+    segs = (
+        tuple(segment_ids[:, ofs:ofs + nk] for (_, ofs, nk) in streams)
+        if segment_ids is not None
+        else ()
+    )
+    return streams, kvs, masks, segs
 
 
 def _stream_offsets(stream, rank, i, n_local, causal, striped, window,
@@ -225,15 +234,27 @@ def _static_hop_band(stream, i: int, n_local, causal, striped, window,
 
 
 def _hop_has_work(
-    hi: jax.Array | None, lo: jax.Array | None, n_q: int, n_k: int
+    hi: jax.Array | None,
+    lo: jax.Array | None,
+    n_q: int,
+    n_k: int,
+    q_seg: jax.Array | None = None,
+    kv_seg: jax.Array | None = None,
 ) -> jax.Array:
+    """Band-based skip, extended by the packed-sequence document check:
+    a hop whose circulating kv block shares no document id range with the
+    local queries contributes nothing and skips its compute — the ring-
+    schedule analogue of the kernels' cross-document tile skip."""
     if hi is None:
-        return jnp.bool_(True)
-    ok = hi >= -(n_q - 1)
-    if lo is not None:
-        # lo > hi means an empty band: striped hops with window < ring_size
-        # hold no in-window keys at all and can skip entirely
-        return ok & (lo <= n_k - 1) & (lo <= hi)
+        ok = jnp.bool_(True)
+    else:
+        ok = hi >= -(n_q - 1)
+        if lo is not None:
+            # lo > hi means an empty band: striped hops with window <
+            # ring_size hold no in-window keys at all and skip entirely
+            ok = ok & (lo <= n_k - 1) & (lo <= hi)
+    if q_seg is not None:
+        ok = ok & segments_overlap(q_seg, kv_seg)
     return ok
 
 
@@ -282,7 +303,7 @@ def _pallas_blocks(bucket_size, nq, nk):
     return bq, bk
 
 
-def _span_ops(q, hk, scale, bucket_size, softclamp_value):
+def _span_ops(q, hk, scale, bucket_size, softclamp_value, q_segment_ids):
     """Per-hop (init, attend, final) for the XLA compute path.
 
     The carry is the online-softmax state; ``attend`` folds one KV span
@@ -295,12 +316,13 @@ def _span_ops(q, hk, scale, bucket_size, softclamp_value):
     def init():
         return init_carry(b, hk, g, n_local, d, like=q)
 
-    def attend(carry, k, v, kv_mask, hi, lo):
+    def attend(carry, k, v, kv_mask, hi, lo, kv_seg=None):
         return attend_blocks(
             q, k, v, carry,
             scale=scale, bucket_size=_fit_bucket(bucket_size, k.shape[2]),
             causal_offset=hi, window_lo=lo, kv_mask=kv_mask,
             softclamp_value=softclamp_value,
+            q_segment_ids=q_segment_ids, kv_segment_ids=kv_seg,
         )
 
     def final(carry):
@@ -311,7 +333,8 @@ def _span_ops(q, hk, scale, bucket_size, softclamp_value):
 
 
 def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
-              bucket_size, softclamp_value, hk, band_hint=None):
+              bucket_size, softclamp_value, hk, band_hint=None,
+              q_seg=None, kv_seg=None):
     """Per-hop backward: returns (dq (b,h,..), dk (b,hk,..), dv (b,hk,..))."""
     if impl == "pallas":
         bq, bk = _pallas_blocks(bucket_size, q.shape[2], k.shape[2])
@@ -321,18 +344,21 @@ def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
             softclamp_value=softclamp_value,
             block_q=bq, block_k=bk,
             band_hint=band_hint,
+            segment_ids=(None if q_seg is None else (q_seg, kv_seg)),
         )
     return flash_backward_blocks(
         do, q, k, v, lse, delta,
         scale=scale, bucket_size=_fit_bucket(bucket_size, k.shape[2]),
         causal_offset=hi, window_lo=lo, kv_mask=kv_mask,
         softclamp_value=softclamp_value,
+        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
     )
 
 
 def _ring_fwd_pallas(
-    q, k, v, kv_mask, axis_name, causal, striped, bucket_size, passes,
-    window, softclamp_value, scale, bidirectional, ring_size, rank, n_local,
+    q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
+    passes, window, softclamp_value, scale, bidirectional, ring_size, rank,
+    n_local,
 ):
     """Pallas ring forward: unrolled hops with in-kernel accumulator resume.
 
@@ -353,22 +379,24 @@ def _ring_fwd_pallas(
     the last hop's post-compute rotations are omitted (their results are
     unused, and being outside any cond this is uniform across devices).
     """
-    streams, kvs, masks = _stream_state(
-        bidirectional, passes, ring_size, n_local, k, v, kv_mask
+    streams, kvs, masks, segs = _stream_state(
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids
     )
     n_spans = passes * len(streams)
     carry = None
     out = lse = None
     span = 0
     for i in range(passes):
-        new_kvs, new_masks = [], []
+        new_kvs, new_masks, new_segs = [], [], []
         for si, stream in enumerate(streams):
             kvx = kvs[si]
             mx = masks[si] if masks else None
+            sx = segs[si] if segs else None
             hi, lo = _stream_offsets(
                 stream, rank, i, n_local, causal, striped, window, ring_size
             )
-            has_work = _hop_has_work(hi, lo, n_local, stream[2])
+            has_work = _hop_has_work(hi, lo, n_local, stream[2],
+                                     segment_ids, sx)
             full, hint = _static_hop_band(
                 stream, i, n_local, causal, striped, window, ring_size
             )
@@ -378,21 +406,22 @@ def _ring_fwd_pallas(
             blk_q, blk_k = _pallas_blocks(
                 bucket_size, q.shape[2], kvx[0].shape[2]
             )
+            seg_pair = None if sx is None else (segment_ids, sx)
 
             def partials(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
-                         blk_q=blk_q, blk_k=blk_k):
+                         blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
                 return pallas_flash_partials(
                     q, kvx[0], kvx[1], mx,
                     scale=scale, causal_offset=hi, window_lo=lo,
                     softclamp_value=softclamp_value,
                     block_q=blk_q, block_k=blk_k,
-                    band_hint=hint, carry=c,
+                    band_hint=hint, carry=c, segment_ids=seg_pair,
                 )
 
             if span == n_spans - 1:
 
                 def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
-                         blk_q=blk_q, blk_k=blk_k):
+                         blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
                     return pallas_flash_fused(
                         q, kvx[0], kvx[1], mx,
                         scale=scale, causal_offset=hi, window_lo=lo,
@@ -402,6 +431,7 @@ def _ring_fwd_pallas(
                         # pallas_flash_fused); by the last hop every row's
                         # carry holds its own-diagonal content
                         band_hint=hint if c is not None else None, carry=c,
+                        segment_ids=seg_pair,
                     )
 
                 if carry is None:  # ring of one: plain fused local sweep
@@ -422,8 +452,12 @@ def _ring_fwd_pallas(
                 new_kvs.append(_rotate(kvx, axis_name, stream[0]))
                 if mx is not None:
                     new_masks.append(_rotate(mx, axis_name, stream[0]))
+                if sx is not None:
+                    new_segs.append(_rotate(sx, axis_name, stream[0]))
         if i < passes - 1:
-            kvs, masks = tuple(new_kvs), tuple(new_masks)
+            kvs, masks, segs = (
+                tuple(new_kvs), tuple(new_masks), tuple(new_segs)
+            )
     return out, lse
 
 
@@ -443,6 +477,7 @@ def ring_flash_attention(
     impl: str = "xla",
     bidirectional: bool = False,
     dkv_dtype: str | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Sequence-parallel exact attention; call inside ``shard_map``.
 
@@ -453,6 +488,12 @@ def ring_flash_attention(
         bandwidth-saving trick, ref ``ring_attention.py:317-321``).
       kv_mask: optional ``(b, n_local)`` key-padding mask shard; rotates
         around the ring with k/v.
+      segment_ids: optional ``(b, n_local)`` int document-id shard for
+        packed sequences: the queries keep the local ids while a kv copy
+        ppermutes around the ring with ``(k, v)`` (and with ``(dk, dv)``
+        in backward), so every hop masks cross-document pairs and hops
+        whose circulating block shares no document id range with the
+        local queries skip their compute entirely.
       axis_name: mesh axis the sequence is sharded over.
       causal/striped: causal masking, with striped (balanced) layout if the
         sequence was stripe-permuted before sharding.
@@ -489,10 +530,22 @@ def ring_flash_attention(
       ``(b, h, n_local, d)`` output shard, in ``q.dtype``.
     """
     check_attention_args("ring_flash_attention", q, k, v, kv_mask)
+    segment_ids, _ = normalize_segment_ids(
+        None if segment_ids is None else (segment_ids, segment_ids),
+        q, q, "ring_flash_attention",
+    )
     if q.shape[2] != k.shape[2]:
         # Cross-attention: each device attends its local KV shard only,
         # exactly like the reference's non-ring fallback.  The causal band
         # (if any) is end-aligned by flash_attention.
+        if segment_ids is not None:
+            # not an assert: under python -O this fallback would silently
+            # compute cross-document attention (it never threads the ids)
+            raise ValueError(
+                "ring_flash_attention: segment_ids need equal q/kv shard "
+                "lengths (packed self-attention); the cross-attention "
+                "fallback does not define a kv-side packing"
+            )
         from ..ops.flash import flash_attention
         from ..ops.pallas_flash import pallas_flash_attention
 
@@ -506,31 +559,32 @@ def ring_flash_attention(
             window=window, softclamp_value=softclamp_value, scale=scale,
         )
     return _ring_flash_attention_core(
-        q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
-        dkv_dtype,
+        q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
+        bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
+        bidirectional, dkv_dtype,
     )
 
 
 @partial(
     jax.custom_vjp,
-    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14),
+    nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
 )
 def _ring_flash_attention_core(
-    q, k, v, kv_mask, axis_name, causal=False, striped=False,
+    q, k, v, kv_mask, segment_ids, axis_name, causal=False, striped=False,
     bucket_size=None, max_ring_passes=None, window=None,
     softclamp_value=None, scale=None, impl="xla", bidirectional=False,
     dkv_dtype=None,
 ):
     out, _ = _ring_fwd_impl(
-        q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
+        q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
+        bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
+        bidirectional,
     )
     return out
 
 
 def _ring_fwd_impl(
-    q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+    q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
 ):
     if window is not None:
@@ -545,34 +599,38 @@ def _ring_fwd_impl(
 
     if impl == "pallas":
         out, lse = _ring_fwd_pallas(
-            q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-            passes, window, softclamp_value, scale, bidirectional,
-            ring_size, rank, n_local,
+            q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
+            bucket_size, passes, window, softclamp_value, scale,
+            bidirectional, ring_size, rank, n_local,
         )
         out = checkpoint_name(out, "flash_out")
         lse = checkpoint_name(lse, "flash_lse")
         return out, lse
 
-    init, attend, final = _span_ops(q, hk, scale, bucket_size, softclamp_value)
+    init, attend, final = _span_ops(
+        q, hk, scale, bucket_size, softclamp_value, segment_ids
+    )
     carry = init()
     # one stacked (k, v) message per stream per hop, ref ring_flash_attention.py:129
-    streams, kvs, masks = _stream_state(
-        bidirectional, passes, ring_size, n_local, k, v, kv_mask
+    streams, kvs, masks, segs = _stream_state(
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids
     )
 
-    def hop(i, flash, kvs, masks):
-        new_kvs, new_masks = [], []
+    def hop(i, flash, kvs, masks, segs):
+        new_kvs, new_masks, new_segs = [], [], []
         for si, stream in enumerate(streams):
             kvx = kvs[si]
             mx = masks[si] if masks else None
+            sx = segs[si] if segs else None
             hi, lo = _stream_offsets(
                 stream, rank, i, n_local, causal, striped, window, ring_size
             )
-            has_work = _hop_has_work(hi, lo, n_local, stream[2])
+            has_work = _hop_has_work(hi, lo, n_local, stream[2],
+                                     segment_ids, sx)
             flash = lax.cond(
                 has_work,
-                lambda f, kvx=kvx, mx=mx, hi=hi, lo=lo: attend(
-                    f, kvx[0], kvx[1], mx, hi, lo
+                lambda f, kvx=kvx, mx=mx, hi=hi, lo=lo, sx=sx: attend(
+                    f, kvx[0], kvx[1], mx, hi, lo, sx
                 ),
                 lambda f: f,
                 flash,
@@ -582,14 +640,16 @@ def _ring_fwd_impl(
             new_kvs.append(_rotate(kvx, axis_name, stream[0]))
             if mx is not None:
                 new_masks.append(_rotate(mx, axis_name, stream[0]))
-        return flash, tuple(new_kvs), tuple(new_masks)
+            if sx is not None:
+                new_segs.append(_rotate(sx, axis_name, stream[0]))
+        return flash, tuple(new_kvs), tuple(new_masks), tuple(new_segs)
 
     def body(c, i):
-        flash, kvs, masks = c
-        return hop(i, flash, kvs, masks), None
+        flash, kvs, masks, segs = c
+        return hop(i, flash, kvs, masks, segs), None
 
-    (carry, _, _), _ = lax.scan(
-        body, (carry, kvs, masks), jnp.arange(passes)
+    (carry, _, _, _), _ = lax.scan(
+        body, (carry, kvs, masks, segs), jnp.arange(passes)
     )
 
     out, lse = final(carry)
@@ -605,22 +665,23 @@ def _ring_fwd_impl(
 
 
 def _ring_vjp_fwd(
-    q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
+    q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
     dkv_dtype,
 ):
     out, lse = _ring_fwd_impl(
-        q, k, v, kv_mask, axis_name, causal, striped, bucket_size,
-        max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
+        q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
+        bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
+        bidirectional,
     )
-    return out, (q, k, v, kv_mask, out, lse)
+    return out, (q, k, v, kv_mask, segment_ids, out, lse)
 
 
 def _ring_vjp_bwd(
     axis_name, causal, striped, bucket_size, max_ring_passes, window,
     softclamp_value, scale, impl, bidirectional, dkv_dtype, res, do,
 ):
-    q, k, v, kv_mask, out, lse = res
+    q, k, v, kv_mask, segment_ids, out, lse = res
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     if scale is None:
@@ -638,8 +699,8 @@ def _ring_vjp_bwd(
             * _group_q(out, hk).astype(jnp.float32)
         ).sum(-1)
 
-    streams, kvs, masks = _stream_state(
-        bidirectional, passes, ring_size, n_local, k, v, kv_mask
+    streams, kvs, masks, segs = _stream_state(
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids
     )
     # circulating dk/dv accumulators: f32 by default; bf16 halves backward
     # ring bandwidth (ref ring_flash_attention_cuda.py:255-260)
@@ -650,15 +711,17 @@ def _ring_vjp_bwd(
     )
     dq = match_vma(jnp.zeros((b, h, n_local, d), jnp.float32), q)
 
-    def hop(i, dq, kvs, dkvs, masks):
-        new_kvs, new_dkvs, new_masks = [], [], []
+    def hop(i, dq, kvs, dkvs, masks, segs):
+        new_kvs, new_dkvs, new_masks, new_segs = [], [], [], []
         for si, stream in enumerate(streams):
             kvx, dkvx = kvs[si], dkvs[si]
             mx = masks[si] if masks else None
+            sx = segs[si] if segs else None
             hi, lo = _stream_offsets(
                 stream, rank, i, n_local, causal, striped, window, ring_size
             )
-            has_work = _hop_has_work(hi, lo, n_local, stream[2])
+            has_work = _hop_has_work(hi, lo, n_local, stream[2],
+                                     segment_ids, sx)
             if isinstance(i, int):
                 full, hint = _static_hop_band(
                     stream, i, n_local, causal, striped, window, ring_size
@@ -668,11 +731,12 @@ def _ring_vjp_bwd(
             else:
                 hint = None
 
-            def do_bwd(args, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint):
+            def do_bwd(args, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint, sx=sx):
                 dq, dkvx = args
                 dq_i, dk_i, dv_i = _span_bwd(
                     impl, do, q, kvx[0], kvx[1], lse, delta, mx, hi, lo,
                     scale, bucket_size, softclamp_value, hk, hint,
+                    segment_ids, sx,
                 )
                 return dq + dq_i, (
                     dkvx.at[0].add(dk_i.astype(dkvx.dtype))
@@ -684,20 +748,23 @@ def _ring_vjp_bwd(
             new_dkvs.append(_rotate(dkvx, axis_name, stream[0]))
             if mx is not None:
                 new_masks.append(_rotate(mx, axis_name, stream[0]))
-        return dq, tuple(new_kvs), tuple(new_dkvs), tuple(new_masks)
+            if sx is not None:
+                new_segs.append(_rotate(sx, axis_name, stream[0]))
+        return (dq, tuple(new_kvs), tuple(new_dkvs), tuple(new_masks),
+                tuple(new_segs))
 
     if impl == "pallas":
         # unrolled for static per-hop bands (see _ring_fwd_impl)
         for i in range(passes):
-            dq, kvs, dkvs, masks = hop(i, dq, kvs, dkvs, masks)
+            dq, kvs, dkvs, masks, segs = hop(i, dq, kvs, dkvs, masks, segs)
     else:
 
         def body(c, i):
-            dq, kvs, dkvs, masks = c
-            return hop(i, dq, kvs, dkvs, masks), None
+            dq, kvs, dkvs, masks, segs = c
+            return hop(i, dq, kvs, dkvs, masks, segs), None
 
-        (dq, kvs, dkvs, _), _ = lax.scan(
-            body, (dq, kvs, dkvs, masks), jnp.arange(passes)
+        (dq, kvs, dkvs, _, _), _ = lax.scan(
+            body, (dq, kvs, dkvs, masks, segs), jnp.arange(passes)
         )
 
     # Catch-up rotation: after `passes` end-of-hop rotations by `shift` the
@@ -721,6 +788,7 @@ def _ring_vjp_bwd(
         dq.astype(q.dtype),
         dkv[0].astype(k.dtype),
         dkv[1].astype(v.dtype),
+        None,
         None,
     )
 

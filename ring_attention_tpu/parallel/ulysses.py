@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import compat
+from ..ops.attention import normalize_segment_ids
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import pallas_flash_attention
 from ..utils.validate import check_attention_args
@@ -42,6 +43,7 @@ def ulysses_attention(
     softclamp_value: float | None = None,
     scale: float | None = None,
     impl: str = "xla",
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Head-parallel exact attention; call inside ``shard_map``.
 
@@ -50,8 +52,16 @@ def ulysses_attention(
     (small-hk GQA), KV heads are auto-repeated up to the axis size — grads
     sum back over the copies.  Sequence layout is contiguous (no striping
     needed — head parallelism is inherently balanced under causal masking).
+
+    ``segment_ids``: optional ``(b, n_local)`` int document-id shard for
+    packed sequences; all-gathered (like ``kv_mask``) since each device
+    attends the full sequence after the all-to-all.
     """
     check_attention_args("ulysses_attention", q, k, v, kv_mask, equal_qkv_len=True)
+    segment_ids, _ = normalize_segment_ids(
+        None if segment_ids is None else (segment_ids, segment_ids),
+        q, q, "ulysses_attention",
+    )
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     world = compat.axis_size(axis_name)
@@ -86,16 +96,23 @@ def ulysses_attention(
         if kv_mask is not None
         else None
     )
+    seg_full = (
+        lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        if segment_ids is not None
+        else None
+    )
 
     if impl == "pallas":
         out = pallas_flash_attention(
             qh, kh, vh, mask_full, causal=causal, window=window,
             softclamp_value=softclamp_value, scale=scale,
+            segment_ids=seg_full,
         )
     else:
         out = flash_attention(
             qh, kh, vh, mask_full, causal=causal, bucket_size=bucket_size,
             window=window, softclamp_value=softclamp_value, scale=scale,
+            segment_ids=seg_full,
         )
 
     # head-sharded -> seq-sharded

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import compat
+from ..ops.attention import normalize_segment_ids
 from ..ops.flash import attend_blocks, finalize, init_carry, _ungroup
 from ..ops.pallas_flash import (
     finalize_partials,
@@ -89,50 +90,61 @@ def zigzag_positions(n_local: int, rank: jax.Array, ring_size: int) -> jax.Array
     return jnp.concatenate([first, second])
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _pallas_chunk_attention(qc, k_all, v_all, causal_offset, scale,
-                            softclamp_value, block):
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _pallas_chunk_attention(qc, k_all, v_all, qc_seg, kv_seg, causal_offset,
+                            scale, softclamp_value, block):
     """Differentiable Pallas attention of one zig-zag query chunk against the
     gathered canonical KV.  ``causal_offset`` is the chunk's global start
     (traced — it depends on the device's rank); dk/dv flow into the
     enclosing ``lax.all_gather``'s transpose (reduce-scatter), the analogue
-    of the reference's autograd AllGather backward (ref distributed.py:103-107)."""
+    of the reference's autograd AllGather backward (ref distributed.py:103-107).
+    ``qc_seg``/``kv_seg`` are the chunk's / gathered canonical segment ids
+    for packed sequences (None when unsegmented)."""
     out, _ = _pallas_chunk_fwd_impl(
-        qc, k_all, v_all, causal_offset, scale, softclamp_value, block
+        qc, k_all, v_all, qc_seg, kv_seg, causal_offset, scale,
+        softclamp_value, block
     )
     return out
 
 
-def _pallas_chunk_fwd_impl(qc, k_all, v_all, causal_offset, scale,
-                           softclamp_value, block):
+def _seg_pair(q_seg, kv_seg):
+    return None if q_seg is None else (q_seg, kv_seg)
+
+
+def _pallas_chunk_fwd_impl(qc, k_all, v_all, qc_seg, kv_seg, causal_offset,
+                           scale, softclamp_value, block):
     parts = pallas_flash_partials(
         qc, k_all, v_all,
         scale=scale, causal_offset=causal_offset,
         softclamp_value=softclamp_value,
         block_q=block, block_k=block,
+        segment_ids=_seg_pair(qc_seg, kv_seg),
     )
     out, lse = finalize_partials(parts)
     return out, lse
 
 
-def _pallas_chunk_vjp_fwd(qc, k_all, v_all, causal_offset, scale,
-                          softclamp_value, block):
+def _pallas_chunk_vjp_fwd(qc, k_all, v_all, qc_seg, kv_seg, causal_offset,
+                          scale, softclamp_value, block):
     out, lse = _pallas_chunk_fwd_impl(
-        qc, k_all, v_all, causal_offset, scale, softclamp_value, block
+        qc, k_all, v_all, qc_seg, kv_seg, causal_offset, scale,
+        softclamp_value, block
     )
-    return out, (qc, k_all, v_all, causal_offset, out, lse)
+    return out, (qc, k_all, v_all, qc_seg, kv_seg, causal_offset, out, lse)
 
 
 def _pallas_chunk_vjp_bwd(scale, softclamp_value, block, res, do):
-    qc, k_all, v_all, causal_offset, out, lse = res
+    qc, k_all, v_all, qc_seg, kv_seg, causal_offset, out, lse = res
     delta = (do.astype(jnp.float32) * out).sum(-1)
     dq, dk, dv = pallas_flash_backward(
         do, qc, k_all, v_all, lse, delta,
         scale=scale, causal_offset=causal_offset,
         softclamp_value=softclamp_value,
         block_q=block, block_k=block,
+        segment_ids=_seg_pair(qc_seg, kv_seg),
     )
-    return dq.astype(qc.dtype), dk.astype(k_all.dtype), dv.astype(v_all.dtype), None
+    return (dq.astype(qc.dtype), dk.astype(k_all.dtype),
+            dv.astype(v_all.dtype), None, None, None)
 
 
 _pallas_chunk_attention.defvjp(_pallas_chunk_vjp_fwd, _pallas_chunk_vjp_bwd)
@@ -164,6 +176,7 @@ def zigzag_attention(
     scale: float | None = None,
     impl: str = "xla",
     gathered_kv_budget: int | None = GATHERED_KV_BUDGET_BYTES,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Zig-zag sharded attention; call inside ``shard_map``.
 
@@ -173,6 +186,10 @@ def zigzag_attention(
     end-aligned causal prefix via blockwise flash (``impl="xla"``) or the
     Pallas kernels (``impl="pallas"``).
 
+    ``segment_ids``: optional ``(b, n_local)`` int document-id shard (in
+    zig-zag layout, like q) for packed sequences; gathered and un-permuted
+    alongside K/V so each chunk masks cross-document attention.
+
     ``gathered_kv_budget``: warn at trace time when the per-device gathered
     K+V exceed this many bytes (``None`` disables) — see
     :data:`GATHERED_KV_BUDGET_BYTES` for why the fix is the ring scheme,
@@ -180,6 +197,10 @@ def zigzag_attention(
     """
     assert causal, "zig-zag CP is a causal-load-balancing scheme (ref zig_zag_attention.py:102-103)"
     check_attention_args("zigzag_attention", q, k, v, equal_qkv_len=True)
+    segment_ids, _ = normalize_segment_ids(
+        None if segment_ids is None else (segment_ids, segment_ids),
+        q, q, "zigzag_attention",
+    )
     b, h, n_local, d = q.shape
     hk = k.shape[1]
     g = h // hk
@@ -207,6 +228,10 @@ def zigzag_attention(
     # static un-permute back to canonical sequence order
     k_all = zigzag_unpermute(k_all, ring_size, axis=2)
     v_all = zigzag_unpermute(v_all, ring_size, axis=2)
+    seg_all = None
+    if segment_ids is not None:
+        seg_all = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        seg_all = zigzag_unpermute(seg_all, ring_size, axis=1)
 
     # flash tile over the gathered keys: largest divisor of the global length
     n_global = k_all.shape[2]
@@ -222,13 +247,18 @@ def zigzag_attention(
         (rank * chunk, (2 * ring_size - 1 - rank) * chunk)
     ):
         qc = lax.dynamic_slice_in_dim(q, which * chunk, chunk, axis=2)
+        qc_seg = (
+            lax.dynamic_slice_in_dim(segment_ids, which * chunk, chunk, axis=1)
+            if segment_ids is not None
+            else None
+        )
         # causal band, end-aligned to the chunk's global end: local row i
         # (global start_expr + i) sees keys j <= start_expr + i
         if impl == "pallas":
             outs.append(
                 _pallas_chunk_attention(
-                    qc, k_all, v_all, start_expr, scale, softclamp_value,
-                    bucket,
+                    qc, k_all, v_all, qc_seg, seg_all, start_expr, scale,
+                    softclamp_value, bucket,
                 )
             )
         else:
@@ -238,6 +268,7 @@ def zigzag_attention(
                 scale=scale, bucket_size=bucket,
                 causal_offset=start_expr,
                 softclamp_value=softclamp_value,
+                q_segment_ids=qc_seg, kv_segment_ids=seg_all,
             )
             out_g, _ = finalize(carry)
             outs.append(_ungroup(out_g))

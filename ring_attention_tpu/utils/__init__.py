@@ -11,6 +11,7 @@ from .train import StepStats, init_step_stats, make_train_step, shard_optimizer_
 from .validate import check_attention_args, check_model_input, check_tokens_input
 
 __all__ = [
+    "enable_compile_cache",
     "make_train_step",
     "shard_optimizer_state",
     "StepStats",

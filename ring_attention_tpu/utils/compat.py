@@ -68,6 +68,23 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     )
 
 
+def jit(fn, *, donate_argnums=(), **kwargs):
+    """``jax.jit`` with buffer donation, degrading to no donation.
+
+    Donation is an aliasing hint — XLA reuses the donated input buffers
+    for outputs instead of double-allocating (the train loop's
+    ``(params, opt_state)`` are exactly the buffers whose copies would
+    otherwise double peak optimizer-state memory).  Old/exotic jax builds
+    that reject the kwarg fall back to a plain jit: the program is then
+    merely less memory-efficient, never wrong."""
+    if donate_argnums:
+        try:
+            return jax.jit(fn, donate_argnums=donate_argnums, **kwargs)
+        except TypeError:
+            pass
+    return jax.jit(fn, **kwargs)
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` (new name) or ``pltpu.TPUCompilerParams``
     (old name) — same dataclass across the rename; every field this repo

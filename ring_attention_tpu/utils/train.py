@@ -56,6 +56,7 @@ def make_train_step(
     accum_steps: int = 1,
     skip_nonfinite: bool = False,
     clip_grad_norm: float | None = None,
+    jit_donate: bool = False,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
@@ -86,6 +87,12 @@ def make_train_step(
       ``stats.step_ok`` reports this step, ``stats.skipped`` counts all
       skips.  The returned loss is NOT masked on a skipped step, so logs
       show the offending value.
+    - ``jit_donate=True`` — return the step already jit-compiled with
+      ``(params, opt_state)`` donated (``utils/compat.py jit``): XLA
+      reuses their buffers for the updated state instead of
+      double-allocating — at long context the Adam moments are the next
+      HBM cliff after activations.  Callers jitting by hand should pass
+      ``donate_argnums=(0, 1)`` themselves.
     """
     if accum_steps < 1:
         raise ValueError(f"make_train_step: accum_steps must be >= 1, got {accum_steps}")
@@ -143,6 +150,13 @@ def make_train_step(
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss, grads
 
+    def finish(step):
+        if not jit_donate:
+            return step
+        from . import compat
+
+        return compat.jit(step, donate_argnums=(0, 1))
+
     if not skip_nonfinite:
 
         def step(params, opt_state, *batch):
@@ -151,7 +165,7 @@ def make_train_step(
             )
             return new_params, new_opt_state, loss
 
-        return step
+        return finish(step)
 
     def guarded_step(params, opt_state, stats: StepStats, *batch):
         new_params, new_opt_state, loss, grads = compute_update(
@@ -178,7 +192,7 @@ def make_train_step(
         )
         return params, opt_state, stats, loss
 
-    return guarded_step
+    return finish(guarded_step)
 
 
 def shard_optimizer_state(

@@ -69,6 +69,50 @@ def test_fuzz_configs(mesh, case):
     )
 
 
+SEG_CASES = [
+    # (b, heads, kv_heads, n, dh, sp, striped, causal, n_docs, use_pallas)
+    # (the targeted layout/path matrix lives in tests/test_segments.py;
+    # these draw RANDOM packings over the schemes)
+    (1, 2, 1, 37, 8, "ring", False, True, 3, False),
+    (1, 8, 4, 48, 8, "zigzag", False, True, 3, False),
+    (2, 8, 8, 56, 8, "ulysses", False, True, 2, False),
+    (1, 4, 2, 64, 8, "ring", True, True, 3, True),  # pallas interpret
+]
+
+
+@pytest.mark.parametrize(
+    "case", SEG_CASES, ids=[f"seg{i}" for i in range(len(SEG_CASES))]
+)
+def test_fuzz_random_packings(mesh, case):
+    """Random document packings (case-seeded boundaries) through every
+    context-parallel scheme vs the dense per-document oracle
+    (force_regular_attn -> default_attention's independent segment-mask
+    path)."""
+    b, h, kvh, n, dh, sp, striped, causal, n_docs, use_pallas = case
+    rng = np.random.default_rng(zlib.crc32(repr(("seg", case)).encode()))
+    dim = h * dh
+    # random packing: n_docs documents with random (>=2 token) boundaries
+    cuts = np.sort(rng.choice(np.arange(2, n - 1), n_docs - 1, replace=False))
+    ids = np.zeros(n, np.int32)
+    for doc, start in enumerate(cuts):
+        ids[start:] = doc + 1
+    seg = jnp.asarray(np.broadcast_to(ids, (b, n)).copy())
+    common = dict(dim=dim, heads=h, dim_head=dh, kv_heads=kvh, causal=causal,
+                  bucket_size=8)
+    sharded = RingAttention(
+        use_ring=True, auto_shard=True, mesh=mesh, sequence_parallel=sp,
+        striped=striped, use_pallas=use_pallas, **common,
+    )
+    oracle = RingAttention(use_ring=False, force_regular_attn=True, **common)
+    x = jnp.asarray(rng.standard_normal((b, n, dim)), jnp.float32)
+    params = oracle.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        sharded.apply(params, x, None, seg),
+        oracle.apply(params, x, None, seg),
+        atol=ATOL, err_msg=str(case),
+    )
+
+
 def test_bidirectional_bucket_divides_full_but_not_half():
     """Bucket divides the full shard but not the half-streams (n_local=12,
     bucket=4): the per-stream refit in parallel/ring.py must fit the bucket

@@ -1,0 +1,434 @@
+"""Packed-sequence (segment-id) attention: the segment-parity suite.
+
+Ground truth is the *per-document dense reference*: run the oracle
+independently on each document's slice and stitch the outputs — packed
+attention with segment ids must match it exactly (up to normal float
+noise), on every path: the oracle's own segment masking, the XLA flash
+path (fwd + bwd, bucketed, GQA, softclamp, windows), the Pallas kernels
+in interpret mode (runtime ids AND the trace-time block-aligned
+``doc_starts`` tables), and every context-parallel scheme on the
+8-virtual-device CPU mesh (plain ring, striped ring, zig-zag, ulysses).
+
+Also pinned here: cross-segment attention weights are EXACTLY zero (a
+perturbation of one document cannot change another bitwise on the XLA
+path), the compact causal grid dispatches measurably fewer tiles for a
+block-aligned 2-document packing (via the band-table helpers), and the
+transformer's packed loss drops exactly the document-boundary labels.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ring_attention_tpu.models import RingAttention, RingTransformer
+from ring_attention_tpu.ops import default_attention, flash_attention
+from ring_attention_tpu.ops.pallas_flash import (
+    _MAX_COMPACT_TILES,
+    _TF_WORK,
+    _band_tables,
+    _band_tile_count,
+    pallas_flash_attention,
+)
+from ring_attention_tpu.parallel import create_mesh
+
+ATOL = 3e-5
+GRAD_ATOL = 1e-4
+
+
+def make_seg(b: int, bounds: tuple[int, ...], n: int) -> jnp.ndarray:
+    """(b, n) int32 ids for documents starting at ``bounds`` (first 0)."""
+    ids = np.zeros(n, np.int32)
+    for doc, start in enumerate(bounds):
+        ids[start:] = doc
+    return jnp.asarray(np.broadcast_to(ids, (b, n)).copy())
+
+
+def per_doc_reference(q, k, v, bounds, n, *, causal, softclamp_value=None):
+    """Dense oracle run independently per document, outputs stitched."""
+    edges = list(bounds) + [n]
+    outs = []
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        outs.append(
+            default_attention(
+                q[:, :, lo:hi], k[:, :, lo:hi], v[:, :, lo:hi],
+                causal=causal, softclamp_value=softclamp_value,
+            )
+        )
+    return jnp.concatenate(outs, axis=2)
+
+
+def make_qkv(rng, b=2, h=4, hk=None, n=64, d=8):
+    hk = hk or h
+    mk = lambda *s: jnp.asarray(rng.standard_normal(s), jnp.float32)  # noqa: E731
+    return mk(b, h, n, d), mk(b, hk, n, d), mk(b, hk, n, d)
+
+
+# ----------------------------------------------------------------------
+# Oracle + XLA flash path
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_oracle_segments_match_per_document(rng, causal):
+    b, n = 2, 60
+    bounds = (0, 17, 41)
+    q, k, v = make_qkv(rng, b=b, n=n)
+    seg = make_seg(b, bounds, n)
+    ref = per_doc_reference(q, k, v, bounds, n, causal=causal)
+    out = default_attention(q, k, v, causal=causal, segment_ids=seg)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hk,softclamp", [(4, None), (2, 5.0)])
+def test_flash_segments_fwd_bwd(rng, causal, hk, softclamp):
+    """Bucketed flash (buckets cross doc boundaries -> mask AND whole-
+    bucket skip both exercised) vs the per-document dense reference,
+    forward and dq/dk/dv."""
+    b, n = 2, 64
+    bounds = (0, 23, 48)
+    q, k, v = make_qkv(rng, b=b, hk=hk, n=n)
+    seg = make_seg(b, bounds, n)
+    ref = per_doc_reference(q, k, v, bounds, n, causal=causal,
+                            softclamp_value=softclamp)
+    out = flash_attention(q, k, v, causal=causal, bucket_size=16,
+                          softclamp_value=softclamp, segment_ids=seg)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    g = jax.grad(
+        loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, bucket_size=16,
+            softclamp_value=softclamp, segment_ids=seg)),
+        (0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda q, k, v: default_attention(
+            q, k, v, causal=causal, softclamp_value=softclamp,
+            segment_ids=seg)),
+        (0, 1, 2),
+    )(q, k, v)
+    for ours, theirs, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(ours, theirs, atol=GRAD_ATOL,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_cross_segment_weights_exactly_zero(rng):
+    """Perturbing document B's keys/values must not change document A's
+    outputs AT ALL — masked logits underflow to weight 0.0 exactly, so
+    the comparison is bitwise, not approximate."""
+    b, n = 1, 48
+    bounds = (0, 20)
+    q, k, v = make_qkv(rng, b=b, n=n)
+    seg = make_seg(b, bounds, n)
+
+    out = flash_attention(q, k, v, causal=True, bucket_size=8,
+                          segment_ids=seg)
+    k2 = k.at[:, :, 20:].add(37.0)
+    v2 = v.at[:, :, 20:].add(-11.0)
+    out2 = flash_attention(q, k2, v2, causal=True, bucket_size=8,
+                           segment_ids=seg)
+    assert np.array_equal(
+        np.asarray(out[:, :, :20]), np.asarray(out2[:, :, :20])
+    ), "document A's outputs changed when document B was perturbed"
+    # and B did change (the test has power)
+    assert not np.array_equal(
+        np.asarray(out[:, :, 20:]), np.asarray(out2[:, :, 20:])
+    )
+
+
+def test_flash_segments_with_window(rng):
+    """Lookback window + segments compose: reference = per-document dense
+    attention windowed inside each document (window counts positions, and
+    cross-document positions are masked anyway)."""
+    b, n, w = 1, 48, 8
+    bounds = (0, 19)
+    q, k, v = make_qkv(rng, b=b, n=n)
+    seg = make_seg(b, bounds, n)
+    out = flash_attention(q, k, v, causal=True, bucket_size=8, window=w,
+                          segment_ids=seg)
+
+    # dense reference with the combined mask
+    s = jnp.einsum("bhid,bhjd->bhij", q, k) * (q.shape[-1] ** -0.5)
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = (j <= i) & (j > i - w) & (seg[0][i] == seg[0][j])
+    s = jnp.where(keep[None, None], s, -1e30)
+    ref = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+# ----------------------------------------------------------------------
+# Pallas kernels (interpret mode)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_segments_fwd_bwd(rng, causal):
+    b, n = 2, 64
+    bounds = (0, 23, 48)
+    q, k, v = make_qkv(rng, b=b, hk=2, n=n)
+    seg = make_seg(b, bounds, n)
+    ref = per_doc_reference(q, k, v, bounds, n, causal=causal)
+    out = pallas_flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                                 interpret=True)
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+    g = jax.grad(
+        lambda q, k, v: (pallas_flash_attention(
+            q, k, v, causal=causal, segment_ids=seg, interpret=True
+        ) ** 2).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (default_attention(
+            q, k, v, causal=causal, segment_ids=seg) ** 2).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    for ours, theirs, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(ours, theirs, atol=GRAD_ATOL,
+                                   err_msg=f"d{name}")
+
+
+def test_pallas_doc_starts_trace_time_skip(rng):
+    """A block-boundary-aligned declared packing (trace-time tile drop,
+    no runtime refs) must equal both the runtime-id path and the
+    per-document dense reference — fwd and bwd."""
+    from ring_attention_tpu.ops.pallas_flash import (
+        finalize_partials,
+        pallas_flash_backward,
+        pallas_flash_partials,
+    )
+
+    b, h, n, d = 1, 2, 128, 8
+    bounds = (0, 64)
+    q, k, v = make_qkv(rng, b=b, h=h, n=n, d=d)
+    seg = make_seg(b, bounds, n)
+    scale = d ** -0.5
+
+    aligned = pallas_flash_partials(
+        q, k, v, scale=scale, causal_offset=0, block_q=32, block_k=32,
+        doc_starts=bounds, interpret=True,
+    )
+    runtime = pallas_flash_partials(
+        q, k, v, scale=scale, causal_offset=0, block_q=32, block_k=32,
+        segment_ids=seg, interpret=True,
+    )
+    out_a, lse_a = finalize_partials(aligned)
+    out_r, _ = finalize_partials(runtime)
+    ref = per_doc_reference(q, k, v, bounds, n, causal=True)
+    np.testing.assert_allclose(out_a, ref.astype(jnp.float32), atol=ATOL)
+    np.testing.assert_allclose(out_r, ref.astype(jnp.float32), atol=ATOL)
+
+    do = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    delta = (do * out_a).sum(-1)
+    grads_a = pallas_flash_backward(
+        do, q, k, v, lse_a, delta, scale=scale, causal_offset=0,
+        block_q=32, block_k=32, doc_starts=bounds, interpret=True,
+    )
+    g_ref = jax.grad(
+        lambda q, k, v: (default_attention(
+            q, k, v, causal=True, segment_ids=seg
+        ).astype(jnp.float32) * do).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    for ours, theirs, name in zip(grads_a, g_ref, "qkv"):
+        np.testing.assert_allclose(ours, theirs, atol=GRAD_ATOL,
+                                   err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("outer_is_q", [True, False])
+def test_band_tables_two_doc_packing_drops_tiles(outer_is_q):
+    """Acceptance pin: a block-aligned 2-document packing measurably
+    shrinks the compact grid — fewer dispatched (WORK) tiles — and the
+    closed-form tile count stays exact for the doc-filtered tables."""
+    n_blocks, bq, bk = 8, 16, 16
+    hint = (0, 0, 0, 0)  # plain causal diagonal
+    docs = (0, 64)  # two 64-token docs over a 128-token span
+    plain = _band_tables(n_blocks, n_blocks, bq, bk, hint, False,
+                         outer_is_q=outer_is_q)
+    packed = _band_tables(n_blocks, n_blocks, bq, bk, hint, False,
+                          outer_is_q=outer_is_q, doc_starts=docs)
+
+    def work(tf):
+        return int(((tf & _TF_WORK) != 0).sum())
+
+    assert work(packed[2]) < work(plain[2])
+    # two equal causal triangles: exactly half the strictly-off-diagonal
+    # tiles disappear -> 36 -> 2 * 10 work tiles at 8 blocks
+    assert work(plain[2]) == 36
+    assert work(packed[2]) == 20
+    assert packed[0].shape[0] <= _MAX_COMPACT_TILES
+    # the SMEM-cap accounting must agree with the real tables
+    assert _band_tile_count(
+        n_blocks, n_blocks, bq, bk, hint, False, outer_is_q=outer_is_q,
+        doc_starts=docs,
+    ) == packed[0].shape[0]
+
+
+def test_band_tile_count_matches_tables_with_docs():
+    """Closed-form count vs real tables across misalignment-free layouts,
+    windows, and both outer orders."""
+    for hint, windowed in (((0, 0, 0, 0), False), ((0, 0, -24, -24), True)):
+        for docs in ((0, 32), (0, 32, 96), (0, 64, 80)):
+            for outer_is_q in (True, False):
+                args = (8, 8, 16, 16, hint, windowed)
+                assert _band_tile_count(
+                    *args, outer_is_q=outer_is_q, doc_starts=docs
+                ) == _band_tables(
+                    *args, outer_is_q=outer_is_q, doc_starts=docs
+                )[0].shape[0], (hint, windowed, docs, outer_is_q)
+
+
+# ----------------------------------------------------------------------
+# Context-parallel schemes on the 8-virtual-device mesh
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(ring_size=8)
+
+
+SP_CASES = [
+    # (sequence_parallel, striped, heads, causal, use_pallas)
+    ("ring", False, 4, True, False),
+    ("ring", True, 4, True, False),
+    ("ring", False, 4, False, False),
+    ("ring", False, 4, True, True),  # pallas kernels, interpret on CPU
+    ("ring", True, 4, True, True),
+    ("zigzag", False, 4, True, False),
+    ("ulysses", False, 8, True, False),
+]
+
+
+@pytest.mark.parametrize(
+    "case", SP_CASES,
+    ids=[f"{c[0]}{'-striped' if c[1] else ''}"
+         f"{'-noncausal' if not c[3] else ''}{'-pallas' if c[4] else ''}"
+         for c in SP_CASES],
+)
+def test_model_segments_vs_per_document_oracle(mesh, case):
+    """RingAttention with segment_ids on the mesh (auto_shard pads the odd
+    length) vs the force_regular_attn per-document oracle — forward, every
+    context-parallel scheme."""
+    sp, striped, h, causal, use_pallas = case
+    b, dh, n = 2, 8, 61
+    dim = h * dh
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((b, n, dim)), jnp.float32)
+    seg = make_seg(b, (0, 25, 40), n)
+    common = dict(dim=dim, heads=h, dim_head=dh, causal=causal,
+                  bucket_size=8)
+    oracle = RingAttention(use_ring=False, force_regular_attn=True, **common)
+    params = oracle.init(jax.random.PRNGKey(0), x)
+    ref = oracle.apply(params, x, None, seg)
+    sharded = RingAttention(
+        use_ring=True, auto_shard=True, mesh=mesh, sequence_parallel=sp,
+        striped=striped, use_pallas=use_pallas, **common,
+    )
+    out = sharded.apply(params, x, None, seg)
+    np.testing.assert_allclose(out, ref, atol=ATOL, err_msg=str(case))
+
+
+@pytest.mark.parametrize(
+    "sp,striped", [("ring", False), ("ring", True), ("zigzag", False)],
+    ids=["plain", "striped", "zigzag"],
+)
+def test_model_segments_grads_on_mesh(mesh, sp, striped):
+    """Packed backward on the mesh (ring: dk/dv circulate with the kv
+    segment ids; zig-zag: dk/dv reduce-scatter through the gather's
+    transpose) vs the per-document oracle's gradients."""
+    b, h, dh, n = 2, 4, 8, 64
+    dim = h * dh
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.standard_normal((b, n, dim)), jnp.float32)
+    seg = make_seg(b, (0, 21, 44), n)
+    common = dict(dim=dim, heads=h, dim_head=dh, causal=True, bucket_size=8)
+    oracle = RingAttention(use_ring=False, force_regular_attn=True, **common)
+    params = oracle.init(jax.random.PRNGKey(0), x)
+    sharded = RingAttention(
+        use_ring=True, auto_shard=True, mesh=mesh, sequence_parallel=sp,
+        striped=striped, **common,
+    )
+    g = jax.grad(
+        lambda p: (sharded.apply(p, x, None, seg) ** 2).sum()
+    )(params)
+    g_ref = jax.grad(
+        lambda p: (oracle.apply(p, x, None, seg) ** 2).sum()
+    )(params)
+    for ours, theirs in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(ours, theirs, atol=1e-3)
+
+
+# ----------------------------------------------------------------------
+# Transformer loss semantics
+# ----------------------------------------------------------------------
+
+
+def test_transformer_packed_loss_equals_separate_documents(rng):
+    """Packing two documents with segment_ids must give the same causal-LM
+    loss as training them as separate (ignore-padded) batch rows: same
+    per-position nlls, same valid-label count, boundary label dropped."""
+    model = RingTransformer(
+        num_tokens=64, dim=32, depth=2, heads=4, dim_head=8, causal=True,
+        bucket_size=8, use_ring=False,
+    )
+    d1 = rng.integers(0, 64, (1, 5))
+    d2 = rng.integers(0, 64, (1, 7))
+    packed = jnp.asarray(np.concatenate([d1, d2], axis=1), jnp.int32)
+    seg = jnp.asarray(np.repeat([0, 1], [5, 7])[None, :])
+    params = model.init(jax.random.PRNGKey(0), packed)
+    packed_loss = model.apply(params, packed, return_loss=True,
+                              segment_ids=seg)
+
+    toks = np.zeros((2, 12), np.int64)
+    toks[0, :5] = d1
+    toks[1, :7] = d2
+    toks[0, 5:] = -1  # ignore_index: pad labels drop out of the loss
+    toks[1, 7:] = -1
+    # embedding lookups need valid ids; the pad positions' LABELS stay -1
+    # because labels are read before this clamp
+    separate = jnp.asarray(np.where(toks < 0, 0, toks), jnp.int32)
+    labels_ok = jnp.asarray(toks, jnp.int32)
+    # build the separate-row loss from logits + the model's own nll rule
+    logits = model.apply(params, separate[:, :-1])
+    valid = labels_ok[:, 1:] >= 0
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    chosen = jnp.take_along_axis(
+        lf, jnp.where(valid, labels_ok[:, 1:], 0)[..., None], axis=-1
+    )[..., 0]
+    nll = jnp.where(valid, lse - chosen, 0.0)
+    separate_loss = nll.sum() / valid.sum()
+    np.testing.assert_allclose(packed_loss, separate_loss, atol=1e-5)
+
+
+def test_transformer_boundary_labels_dropped(rng):
+    """The first token of each packed document carries no loss: the valid
+    count behind the mean must equal n-1 minus (#docs - 1)."""
+    model = RingTransformer(
+        num_tokens=32, dim=16, depth=1, heads=2, dim_head=8, causal=True,
+        bucket_size=8, use_ring=False,
+    )
+    n = 12
+    tokens = jnp.asarray(rng.integers(0, 32, (1, n)), jnp.int32)
+    seg = make_seg(1, (0, 4, 9), n)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    loss = model.apply(params, tokens, return_loss=True, segment_ids=seg)
+
+    logits = model.apply(params, tokens[:, :-1], segment_ids=seg[:, :-1])
+    labels = tokens[:, 1:]
+    valid = np.asarray(seg)[:, 1:] == np.asarray(seg)[:, :-1]
+    assert valid.sum() == (n - 1) - 2  # two boundary labels dropped
+    lf = np.asarray(logits, np.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    chosen = np.take_along_axis(
+        lf, np.asarray(labels)[..., None], axis=-1
+    )[..., 0]
+    expect = ((lse - chosen) * valid).sum() / valid.sum()
+    np.testing.assert_allclose(loss, expect, atol=1e-5)

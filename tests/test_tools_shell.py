@@ -11,9 +11,11 @@ protocol (temp-dir + rename acquisition; pid-dead + min-age staleness).
 
 import glob
 import os
+import py_compile
 import re
 import shutil
 import subprocess
+import sys
 import textwrap
 
 import pytest
@@ -21,6 +23,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = sorted(glob.glob(os.path.join(REPO, "tools", "*.sh")))
 WATCHER = os.path.join(REPO, "tools", "tpu_window_watch.sh")
+KERNEL_VALIDATE = os.path.join(REPO, "tools", "tpu_kernel_validate.py")
 
 
 def test_tools_exist():
@@ -49,6 +52,28 @@ def test_shellcheck_if_available(script):
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, f"shellcheck {script}:\n{proc.stdout}"
+
+
+# ----------------------------------------------------------------------
+# Python hardware tools: flag-surface smoke (the shell "bash -n" analogue
+# — a broken flag is otherwise only discovered when a TPU window opens)
+# ----------------------------------------------------------------------
+
+
+def test_tpu_kernel_validate_compiles():
+    py_compile.compile(KERNEL_VALIDATE, doraise=True)
+
+
+def test_tpu_kernel_validate_segments_flag_parses():
+    """``--segments`` (the packed-sequence sweep) must be a real flag:
+    ``--help`` exits 0 and documents it — argparse runs before any jax
+    work, so this needs no TPU."""
+    proc = subprocess.run(
+        [sys.executable, KERNEL_VALIDATE, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--segments" in proc.stdout
 
 
 # ----------------------------------------------------------------------

@@ -41,6 +41,11 @@ def main() -> None:
     ap.add_argument("--interpret", action="store_true",
                     help="run kernels in interpret mode (CPU preflight of "
                          "this tool's queued invocations; no Mosaic)")
+    ap.add_argument("--segments", type=int, default=None, metavar="N",
+                    help="packed-sequence sweep: N equal block-aligned "
+                         "documents — parity vs the per-document oracle, "
+                         "compact-grid tile counts (trace-time doc skip), "
+                         "and timed fwd packed vs plain causal")
     args = ap.parse_args()
 
     import jax
@@ -92,6 +97,88 @@ def main() -> None:
         "compact_vs_oracle_max_err": float(jnp.abs(compact - oracle).max()),
     }))
 
+    # ---- packed-sequence (--segments N) sweep
+    if args.segments:
+        import numpy as np
+
+        from ring_attention_tpu.ops.pallas_flash import (
+            _MAX_COMPACT_TILES,
+            _TF_WORK,
+            _band_tables,
+        )
+
+        n_docs = args.segments
+        if n0 % n_docs:
+            # a scarce TPU window must not die on an unlucky N: report and
+            # continue with the rest of the sweep (same convention as the
+            # tile-accounting section below)
+            print(json.dumps({
+                "segments": n_docs, "parity_seq": n0,
+                "note": f"--segments must divide the parity length {n0}; "
+                        f"skipping the packed parity check",
+            }))
+            n_docs = None
+    if args.segments and n_docs:
+        # parity at the small shape: N equal docs, runtime segment ids AND
+        # the trace-time doc-skip tables, both vs the per-document oracle
+        doc_len = n0 // n_docs
+        starts = tuple(range(0, n0, doc_len))
+        seg = jnp.asarray(
+            np.repeat(np.arange(n_docs, dtype=np.int32), doc_len)[None, :]
+        )
+        packed_rt = finalize_partials(
+            pallas_flash_partials(q, k, v, scale=scale, causal_offset=0,
+                                  segment_ids=seg, interpret=args.interpret)
+        )[0]
+        packed_tt = finalize_partials(
+            pallas_flash_partials(q, k, v, scale=scale, causal_offset=0,
+                                  doc_starts=starts, interpret=args.interpret)
+        )[0]
+        per_doc = jnp.concatenate(
+            [
+                default_attention(
+                    q[:, :, s:s + doc_len].astype(jnp.float32),
+                    k[:, :, s:s + doc_len].astype(jnp.float32),
+                    v[:, :, s:s + doc_len].astype(jnp.float32),
+                    causal=True,
+                )
+                for s in starts
+            ],
+            axis=2,
+        )
+        print(json.dumps({
+            "segments": n_docs, "parity_seq": n0,
+            "runtime_vs_per_doc_max_err":
+                float(jnp.abs(packed_rt - per_doc).max()),
+            "tables_vs_per_doc_max_err":
+                float(jnp.abs(packed_tt - per_doc).max()),
+        }))
+
+        # tile accounting at the target shape: how much of the compact
+        # causal grid the declared packing drops at trace time
+        bq = bk = 1024
+        if args.seq % n_docs == 0 and (args.seq // n_docs) % bq == 0:
+            nblk = args.seq // bq
+            starts_t = tuple(range(0, args.seq, args.seq // n_docs))
+            plain = _band_tables(nblk, nblk, bq, bk, (0, 0, 0, 0), False,
+                                 outer_is_q=True)
+            docs_t = _band_tables(nblk, nblk, bq, bk, (0, 0, 0, 0), False,
+                                  outer_is_q=True, doc_starts=starts_t)
+            w_plain = int((plain[2] & _TF_WORK != 0).sum())
+            w_docs = int((docs_t[2] & _TF_WORK != 0).sum())
+            print(json.dumps({
+                "segments": n_docs, "seq": args.seq, "block": bq,
+                "work_tiles_plain": w_plain, "work_tiles_docs": w_docs,
+                "tiles_dropped_frac": round(1 - w_docs / w_plain, 4),
+                "compact": docs_t[0].shape[0] <= _MAX_COMPACT_TILES,
+            }))
+        else:
+            print(json.dumps({
+                "segments": n_docs, "seq": args.seq,
+                "note": "seq must split into N block-aligned docs for the "
+                        "tile accounting",
+            }))
+
     # ---- timing at the target shape
     seq = args.seq
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
@@ -99,13 +186,14 @@ def main() -> None:
     k, v = (jax.random.normal(kk, (1, hk, seq, d), jnp.bfloat16) for kk in ks[1:])
     flops_fwd = 2 * 2 * seq * seq * h * d * 0.5
 
-    def fwd_chained(bq, bk, iters):
+    def fwd_chained(bq, bk, iters, doc_starts=None):
         @jax.jit
         def chained(q, k, v):
             def body(c, _):
                 p = pallas_flash_partials(
                     c, k, v, scale=scale, causal_offset=0,
                     block_q=bq, block_k=bk, interpret=args.interpret,
+                    doc_starts=doc_starts,
                 )
                 o = finalize_partials(p)[0]
                 return c + 1e-3 * o.astype(c.dtype), p.m[0, 0, 0]
@@ -132,6 +220,29 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - sweep must survive rejects
             print(json.dumps({
                 "mode": "fwd", "seq": seq, "block_q": bq, "block_k": bk,
+                "error": f"{type(e).__name__}: {str(e)[:160]}",
+            }))
+
+    # ---- packed fwd timing: the trace-time doc skip vs plain causal at
+    # the same shape (useful FLOPs shrink to the per-document triangles)
+    if args.segments and seq % args.segments == 0 and (
+        (seq // args.segments) % 1024 == 0
+    ):
+        starts_t = tuple(range(0, seq, seq // args.segments))
+        doc_flops = flops_fwd / args.segments  # N equal causal triangles
+        try:
+            compile_s, secs = timed_chained(
+                fwd_chained(1024, 1024, iters, doc_starts=starts_t),
+                (q, k, v), iters,
+            )
+            print(json.dumps({
+                "mode": "fwd-packed", "seq": seq, "segments": args.segments,
+                "tflops_useful": round(doc_flops / secs / 1e12, 1),
+                "ms": round(secs * 1e3, 1), "compile_s": round(compile_s, 1),
+            }))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "mode": "fwd-packed", "seq": seq,
                 "error": f"{type(e).__name__}: {str(e)[:160]}",
             }))
 
