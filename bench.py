@@ -114,6 +114,13 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     if mode == "hops":
         _hops_worker(seq_len, int(extra.get("ring", 4)))
         return
+    if mode == "hybrid":
+        # "world" = TOTAL sequence-parallel degree (outer ring = world /
+        # ulysses); "ring" is accepted as a legacy alias for it
+        _hybrid_worker(seq_len,
+                       int(extra.get("world", extra.get("ring", 4))),
+                       int(extra.get("ulysses", 2)))
+        return
     if mode == "decode":
         _decode_worker(impl, seq_len, extra)
         return
@@ -204,6 +211,113 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     )
 
 
+def _hop_sequence(q, k, v, ring: int, n_local: int, scale: float):
+    """Device R-1's per-hop span calls of a contiguous causal ring: seed
+    partials, in-kernel carry resume, fused normalized final write
+    (parallel/ring.py ``_ring_fwd_pallas``).  Shared by the pure-ring and
+    hybrid hop workers so their kernel schedules cannot diverge."""
+    from ring_attention_tpu.ops.pallas_flash import (
+        pallas_flash_fused,
+        pallas_flash_partials,
+    )
+
+    def hop_kv(i):  # device R-1's hop i holds origin (R-1-i)'s block
+        j = ring - 1 - i
+        sl = slice(j * n_local, (j + 1) * n_local)
+        return k[:, :, sl], v[:, :, sl]
+
+    if ring == 1:  # degenerate factoring: one fused local sweep
+        out, _ = pallas_flash_fused(
+            q, k, v, scale=scale, causal_offset=0, block_q=1024, block_k=1024,
+        )
+        return out
+    kh, vh = hop_kv(0)
+    carry = pallas_flash_partials(
+        q, kh, vh, scale=scale, causal_offset=0, block_q=1024, block_k=1024,
+    )
+    for i in range(1, ring - 1):
+        kh, vh = hop_kv(i)
+        carry = pallas_flash_partials(  # fully-visible span, resumed
+            q, kh, vh, scale=scale, block_q=1024, block_k=1024, carry=carry,
+        )
+    kh, vh = hop_kv(ring - 1)
+    out, _ = pallas_flash_fused(
+        q, kh, vh, scale=scale, block_q=1024, block_k=1024, carry=carry,
+    )
+    return out
+
+
+def _hybrid_worker(seq_len: int, world: int, ulysses: int) -> None:
+    """Single-chip simulation of the hybrid Ulysses x Ring hop sequence.
+
+    At equal sequence-parallel world, the hybrid factoring trades the
+    ``world``-hop ring for a ``world/ulysses``-hop ring over ``h/ulysses``
+    heads (the Ulysses all-to-all legs ride the fast intra-node tier and
+    have no per-hop latency chain).  This worker runs the per-device span
+    calls that remain after the all-to-all — the exact kernel sequence of
+    ``parallel/hybrid.py``'s ring leg: seed, in-kernel resume, fused final
+    write — and reports the hop count next to tokens/sec so the
+    ``hybrid262k`` entry is directly comparable with the ``ring_hops``
+    one."""
+    import jax
+    import jax.numpy as jnp
+
+    assert world % ulysses == 0, f"ulysses {ulysses} must divide world {world}"
+    ring = world // ulysses
+    heads = HEADS // ulysses
+    assert heads >= 1, f"ulysses {ulysses} needs at least {ulysses} heads"
+    dev, peak = _device_peak()
+    n_local = seq_len // ring
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, heads, n_local, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, heads, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, heads, seq_len, DIM_HEAD), jnp.bfloat16)
+    scale = DIM_HEAD**-0.5
+
+    def hop_sequence(q):
+        return _hop_sequence(q, k, v, ring, n_local, scale)
+
+    iters = 3
+
+    @jax.jit
+    def chained(q):
+        def body(carry, _):
+            o = hop_sequence(carry)
+            return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+
+        out, ys = jax.lax.scan(body, q, None, length=iters)
+        return ys.astype(jnp.float32).sum()
+
+    compile_s, secs = _timed(chained, (q,), iters)
+    flops = (
+        FWD_MATMULS * 2 * heads * DIM_HEAD * n_local * n_local * (ring - 0.5)
+    )
+    tflops = flops / secs / 1e12
+    print(
+        json.dumps(
+            {
+                "value": round(tflops, 4),
+                "vs_baseline": round(tflops / peak, 4),
+                "seq_len": seq_len,
+                "world": world,
+                "ulysses": ulysses,
+                "ring": ring,
+                # inter-device transfers in the latency chain, vs world-1
+                # for the pure ring at the same world size
+                "hops": ring - 1,
+                "pure_ring_hops": world - 1,
+                # whole-slice rate: the world processes seq_len queries per
+                # step while each device runs this hop sequence
+                "tokens_per_sec": round(seq_len / secs),
+                "impl": "pallas-hybrid",
+                "device": getattr(dev, "device_kind", str(dev)),
+                "ms_per_step": round(secs * 1e3, 2),
+                "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
 def _hops_worker(seq_len: int, ring: int) -> None:
     """Single-chip simulation of a causal ring's per-device hop sequence.
 
@@ -217,11 +331,6 @@ def _hops_worker(seq_len: int, ring: int) -> None:
     import jax
     import jax.numpy as jnp
 
-    from ring_attention_tpu.ops.pallas_flash import (
-        pallas_flash_fused,
-        pallas_flash_partials,
-    )
-
     dev, peak = _device_peak()
     n_local = seq_len // ring
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -230,28 +339,8 @@ def _hops_worker(seq_len: int, ring: int) -> None:
     v = jax.random.normal(ks[2], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
     scale = DIM_HEAD**-0.5
 
-    def hop_kv(i):  # device R-1's hop i holds origin (R-1-i)'s block
-        j = ring - 1 - i
-        sl = slice(j * n_local, (j + 1) * n_local)
-        return k[:, :, sl], v[:, :, sl]
-
     def hop_sequence(q):
-        kh, vh = hop_kv(0)
-        carry = pallas_flash_partials(
-            q, kh, vh, scale=scale, causal_offset=0,
-            block_q=1024, block_k=1024,
-        )
-        for i in range(1, ring - 1):
-            kh, vh = hop_kv(i)
-            carry = pallas_flash_partials(  # fully-visible span, resumed
-                q, kh, vh, scale=scale, block_q=1024, block_k=1024,
-                carry=carry,
-            )
-        kh, vh = hop_kv(ring - 1)
-        out, _ = pallas_flash_fused(
-            q, kh, vh, scale=scale, block_q=1024, block_k=1024, carry=carry,
-        )
-        return out
+        return _hop_sequence(q, k, v, ring, n_local, scale)
 
     iters = 3
 
@@ -622,6 +711,56 @@ def _last_measured() -> dict:
     return latest
 
 
+def _cached_probe(run_probe):
+    """Run the device probe through a small on-disk cache.
+
+    BENCH_r03–r05 each re-paid the full wedged-tunnel hang (2 x 180 s
+    subprocess kills + backoff) because every bench invocation re-probed a
+    tunnel whose state had not changed.  The probe verdict — healthy or
+    wedged — is cached with a timestamp (``BENCH_PROBE_CACHE``, default
+    under the system temp dir) and reused for ``BENCH_PROBE_TTL_S``
+    seconds (default 900), so back-to-back phases/invocations pay the hang
+    at most once per TTL window.  The emitted JSON marks reused verdicts
+    (``probe_cached`` + age) so a wedged round is never mistaken for a
+    fresh measurement.
+    """
+    import tempfile
+
+    ttl = float(os.environ.get("BENCH_PROBE_TTL_S", 900))
+    path = os.environ.get(
+        "BENCH_PROBE_CACHE",
+        os.path.join(tempfile.gettempdir(), "ring_attention_bench_probe.json"),
+    )
+    # a verdict is only reusable from the same backend selection: the
+    # fault-injection suite probes with JAX_PLATFORMS=nonexistent_backend,
+    # and its wedged verdict must never short-circuit a real TPU round
+    # (nor a healthy CPU verdict mask a wedged tunnel)
+    env_key = os.environ.get("JAX_PLATFORMS", "")
+    if ttl > 0:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            age = time.time() - rec["time"]
+            if (0 <= age <= ttl and isinstance(rec.get("ok"), bool)
+                    and rec.get("env") == env_key):
+                rec["cached"] = True
+                rec["age_s"] = round(age, 1)
+                return rec
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+    rec = run_probe()
+    rec["time"] = time.time()
+    rec["env"] = env_key
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)  # atomic: concurrent benches see old or new
+    except OSError:
+        pass  # cache is an optimization; never fail the bench over it
+    return rec
+
+
 def main() -> None:
     result = {
         "metric": (
@@ -669,20 +808,31 @@ def main() -> None:
             raise RuntimeError(f"device probe failed: {proc.stderr[-300:]}")
         return proc
 
-    try:
-        with_retries(
-            _probe_device,
-            timeout=240,  # backstop over the subprocess's own 180s kill
-            backoff=float(os.environ.get("BENCH_PROBE_BACKOFF_S", 30)),
-            max_attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2)),
-        )
-    except RetryError as e:
-        if isinstance(e.last, (subprocess.TimeoutExpired, TimeoutError)):
-            result["error"] = (
-                "device probe hung (TPU tunnel unresponsive after 180s)"
+    def _run_probe():
+        try:
+            with_retries(
+                _probe_device,
+                timeout=240,  # backstop over the subprocess's own 180s kill
+                backoff=float(os.environ.get("BENCH_PROBE_BACKOFF_S", 30)),
+                max_attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2)),
             )
-        else:
-            result["error"] = str(e.last)
+        except RetryError as e:
+            if isinstance(e.last, (subprocess.TimeoutExpired, TimeoutError)):
+                return {"ok": False, "error": (
+                    "device probe hung (TPU tunnel unresponsive after 180s)"
+                )}
+            return {"ok": False, "error": str(e.last)}
+        return {"ok": True}
+
+    # probe once, reuse across phases AND back-to-back invocations: the
+    # verdict is cached on disk with a TTL (see _cached_probe) so a wedged
+    # tunnel costs its 180 s hang once per window, not once per round
+    probe = _cached_probe(_run_probe)
+    if probe.get("cached"):
+        result["probe_cached"] = True
+        result["probe_age_s"] = probe.get("age_s")
+    if not probe["ok"]:
+        result["error"] = probe.get("error", "device probe failed")
         result["last_measured"] = _last_measured()
         print(json.dumps(result))
         return
@@ -827,6 +977,32 @@ def main() -> None:
                     payload["value"] / result["value"], 4
                 )
             log.append(f"hops:pallas@{TARGET_SEQ}: ok")
+        else:
+            log.append(err)
+
+    # phase 4c — hybrid Ulysses x Ring hop sequence at the same world as
+    # phase 4's pure ring: world/ulysses hops on h/ulysses heads (the
+    # Ulysses all-to-all legs are latency-flat; this measures the kernel
+    # hop chain that remains).  `hybrid262k` sits next to the ring/ulysses
+    # entries with its hop count and whole-slice tokens/sec.
+    if got_target and budget_left(900):
+        payload, err = _run_attempt(
+            "pallas", TARGET_SEQ, "hybrid",
+            min(900, deadline - time.monotonic()),
+            {"world": 4, "ulysses": 2},
+        )
+        if payload is not None:
+            result["hybrid262k"] = payload["value"]
+            result["hybrid_hops"] = payload["hops"]
+            result["hybrid_pure_ring_hops"] = payload["pure_ring_hops"]
+            result["hybrid_ulysses"] = payload["ulysses"]
+            result["hybrid_tokens_per_sec"] = payload["tokens_per_sec"]
+            result["hybrid_ms"] = payload["ms_per_step"]
+            if result.get("ring_hops_tflops"):
+                result["hybrid_vs_ring_hops"] = round(
+                    payload["value"] / result["ring_hops_tflops"], 4
+                )
+            log.append(f"hybrid:pallas@{TARGET_SEQ}[u2]: ok")
         else:
             log.append(err)
 

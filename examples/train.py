@@ -32,6 +32,13 @@ def main() -> None:
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--depth", type=int, default=2)
     ap.add_argument("--ring-size", type=int, default=None)
+    ap.add_argument("--ulysses-size", type=int, default=None,
+                    help="factor the sequence axis as ulysses x ring and "
+                         "train with sequence_parallel='hybrid': all-to-all "
+                         "head parallelism over the inner (fastest) axis, "
+                         "KV-rotation ring over the outer one — "
+                         "ulysses-size x fewer ring hops at equal world "
+                         "size (docs/hybrid_parallelism.md)")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--accum-steps", type=int, default=1,
                     help="gradient-accumulation microbatches per update")
@@ -101,8 +108,16 @@ def main() -> None:
     )
 
     n_dev = len(jax.devices())
-    ring = args.ring_size or n_dev
-    mesh = create_mesh(ring_size=ring) if n_dev > 1 else None
+    ulysses = args.ulysses_size or 1
+    hybrid = ulysses > 1
+    if hybrid:
+        ring = args.ring_size or n_dev // ulysses
+        mesh = create_mesh(ring_size=ring, ulysses_size=ulysses)
+        seq_shards = ulysses * ring
+    else:
+        ring = args.ring_size or n_dev
+        mesh = create_mesh(ring_size=ring) if n_dev > 1 else None
+        seq_shards = ring
     print(f"devices={n_dev} mesh={dict(mesh.shape) if mesh else None}")
 
     model = RingTransformer(
@@ -113,9 +128,10 @@ def main() -> None:
         dim_head=args.dim // 4,
         causal=True,
         striped=True,
-        bucket_size=max(args.seq_len // max(ring, 1), 1),
+        bucket_size=max(args.seq_len // max(seq_shards, 1), 1),
         mesh=mesh,
         use_ring=mesh is not None,
+        sequence_parallel="hybrid" if hybrid else "ring",
         use_pallas=args.use_pallas,
         ring_bidirectional=args.bidirectional,
         remat=args.remat,

@@ -33,6 +33,7 @@ from .parallel import (
     axis_world,
     compact_masked,
     create_mesh,
+    hybrid_attention,
     ring_flash_attention,
     stripe_permute,
     stripe_unpermute,
@@ -62,6 +63,7 @@ __all__ = [
     "apply_rotary",
     "create_mesh",
     "default_attention",
+    "hybrid_attention",
     "flash_attention",
     "pallas_flash_attention",
     "pallas_flash_decode",

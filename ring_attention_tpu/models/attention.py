@@ -38,18 +38,28 @@ from ..ops.pallas_flash import (
     pallas_flash_decode_q8,
     quantize_kv_cache,
 )
-from ..ops.rotary import apply_rotary, ring_positions, rotary_freqs
-from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
+from ..ops.rotary import apply_rotary, hybrid_positions, ring_positions, rotary_freqs
+from ..parallel.hybrid import hybrid_attention
+from ..parallel.mesh import (
+    DATA_AXIS,
+    RING_AXIS,
+    SEQ_AXIS,
+    ULYSSES_AXIS,
+    is_factored,
+    seq_partition,
+    seq_world,
+)
 from ..parallel.ring import ring_flash_attention
 from ..parallel.sharding import (
+    layout_for,
+    layout_permute,
+    layout_unpermute,
     pad_seq_and_mask,
     pad_to_multiple,
-    stripe_permute,
-    stripe_unpermute,
 )
 from ..parallel.tree_decode import tree_attn_decode
 from ..parallel.ulysses import ulysses_attention
-from ..parallel.zigzag import zigzag_attention, zigzag_permute, zigzag_positions, zigzag_unpermute
+from ..parallel.zigzag import zigzag_attention, zigzag_positions
 from ..utils import compat
 from ..utils.validate import check_model_input
 from .layers import RMSNorm
@@ -98,10 +108,14 @@ class RingAttention(nn.Module):
     # (values int8, scales f32) tuples; decode attends via the q8 kernel
     # (use_pallas) or a dequantized oracle fallback
     quantize_cache: bool = False
-    # context-parallel scheme over the seq mesh axis:
+    # context-parallel scheme over the seq mesh axis (or axes):
     #   "ring"    — KV rotation (+ striped load balance); the reference's core
     #   "zigzag"  — Llama-3 chunk pairing + all-gathered KV (causal only)
     #   "ulysses" — all-to-all head parallelism (not in the reference)
+    #   "hybrid"  — Ulysses x Ring 2-D factoring: all-to-all over the inner
+    #               `ulysses` mesh axis, ring over the outer `ring` axis —
+    #               ulysses_size x fewer ring hops at equal world size;
+    #               requires a factored mesh (create_mesh(ulysses_size=U))
     sequence_parallel: str = "ring"
     # circulate KV halves in opposite ring directions (full-duplex ICI);
     # applies when the local shard length is even, unidirectional with a
@@ -135,9 +149,38 @@ class RingAttention(nn.Module):
         return resilience.resolve_attention_impl(self.impl) == "pallas"
 
     def _ring_size(self) -> int:
+        """Total sequence-parallel world (over BOTH axes of a factored mesh)."""
         if self.mesh is None:
             return 1
-        return self.mesh.shape[SEQ_AXIS]
+        return seq_world(self.mesh)
+
+    def _ulysses_size(self) -> int:
+        if self.mesh is None or not is_factored(self.mesh):
+            return 1
+        return self.mesh.shape[ULYSSES_AXIS]
+
+    def _layout(self) -> tuple[str, int]:
+        """(scheme, factor) for the model-top sequence permutation — the
+        shared derivation (``parallel/sharding.py::layout_for``), so this
+        layer and ``RingTransformer`` can never disagree."""
+        return layout_for(
+            self.sequence_parallel, self.striped, self._ring_size(),
+            self._ulysses_size(),
+        )
+
+    def _check_mesh(self) -> None:
+        factored = self.mesh is not None and is_factored(self.mesh)
+        if self.sequence_parallel == "hybrid" and not factored:
+            raise ValueError(
+                'sequence_parallel="hybrid" needs a factored mesh — build '
+                "it with create_mesh(ulysses_size=U, ring_size=R)"
+            )
+        if self.sequence_parallel != "hybrid" and factored:
+            raise ValueError(
+                f'sequence_parallel="{self.sequence_parallel}" runs on a '
+                "plain (data, seq) mesh; the factored (data, ring, ulysses) "
+                'mesh is for sequence_parallel="hybrid"'
+            )
 
     def _bidirectional(self, n_local: int) -> bool:
         """Bidirectional streams need an even local shard; warn on the
@@ -183,12 +226,15 @@ class RingAttention(nn.Module):
         """
         check_model_input("RingAttention", x, self.dim)
         ring = self.use_ring and not self.force_regular_attn and self._ring_size() > 1
-        assert self.sequence_parallel in ("ring", "zigzag", "ulysses")
+        assert self.sequence_parallel in ("ring", "zigzag", "ulysses", "hybrid")
+        if ring:
+            self._check_mesh()
         if self.sequence_parallel == "zigzag":
             assert self.causal, "zig-zag CP is causal-only (ref zig_zag_attention.py:102-103)"
             assert self.max_lookback_seq_len is None, "lookback not supported with zigzag"
 
         n_orig = x.shape[1]
+        scheme, factor = self._layout()
         if ring and self.auto_shard:
             pad_mult = (
                 2 * self._ring_size()
@@ -200,18 +246,15 @@ class RingAttention(nn.Module):
                 segment_ids, _ = pad_to_multiple(
                     segment_ids, pad_mult, value=PAD_SEGMENT_ID
                 )
-            if self.sequence_parallel == "ring" and self.striped:
-                x = stripe_permute(x, self._ring_size())
-                if mask is not None:
-                    mask = stripe_permute(mask, self._ring_size())
-                if segment_ids is not None:
-                    segment_ids = stripe_permute(segment_ids, self._ring_size())
-            elif self.sequence_parallel == "zigzag":
-                x = zigzag_permute(x, self._ring_size())
-                if segment_ids is not None:
-                    segment_ids = zigzag_permute(segment_ids, self._ring_size())
+            x = layout_permute(x, scheme, factor)
+            if mask is not None:
+                mask = layout_permute(mask, scheme, factor)
+            if segment_ids is not None:
+                segment_ids = layout_permute(segment_ids, scheme, factor)
             x = lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
+                x, NamedSharding(
+                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh), None)
+                )
             )
 
         q, k, v = self._project_qkv(x)
@@ -229,10 +272,7 @@ class RingAttention(nn.Module):
         out = self.to_out(out)
 
         if ring and self.auto_shard:
-            if self.sequence_parallel == "ring" and self.striped:
-                out = stripe_unpermute(out, self._ring_size())
-            elif self.sequence_parallel == "zigzag":
-                out = zigzag_unpermute(out, self._ring_size())
+            out = layout_unpermute(out, scheme, factor)
             out = out[:, :n_orig]
         return out
 
@@ -275,12 +315,49 @@ class RingAttention(nn.Module):
             return self._zigzag_attend(q, k, v, segment_ids)
         if self.sequence_parallel == "ulysses":
             return self._ulysses_attend(q, k, v, mask, segment_ids)
+        if self.sequence_parallel == "hybrid":
+            return self._hybrid_attend(q, k, v, mask, segment_ids)
         return self._ring_attend(q, k, v, mask, segment_ids)
 
-    @staticmethod
-    def _seg_spec(segment_ids):
-        """shard_map spec for an optional (b, n) segment-id operand."""
-        return P(DATA_AXIS, SEQ_AXIS) if segment_ids is not None else P()
+    def _seg_spec(self, segment_ids):
+        """shard_map spec for an optional (b, n) per-token operand, on the
+        plain or factored sequence axes."""
+        if segment_ids is None:
+            return P()
+        return P(DATA_AXIS, seq_partition(self.mesh))
+
+    def _ring_leg(self, n_chunk: int):
+        """Ring-leg knobs for chunks of length ``n_chunk`` — the whole
+        local shard for the pure ring, the post-all-to-all chunk for
+        hybrid.  Returns ``(bucket, bidirectional, window,
+        max_ring_passes)``; the ONE copy of the bucket-fit and lookback
+        hop-skip arithmetic, so the two ring callers cannot drift."""
+        # per-hop flash tile: largest divisor of the chunk <= bucket_size
+        bucket = min(self.bucket_size, n_chunk)
+        while n_chunk % bucket:
+            bucket -= 1
+        bidirectional = self._bidirectional(n_chunk)
+        max_ring_passes = None
+        window = None
+        lookback = self.max_lookback_seq_len
+        if lookback is not None:
+            assert self.causal, (
+                "max_lookback_seq_len requires causal attention "
+                "(ref ring_flash_attention.py:99)"
+            )
+            window = lookback
+            if not self.striped:
+                # contiguous layout: distant hops carry no in-window keys,
+                # so cover ceil((window-1)/n_chunk) earlier chunks plus our
+                # own (exact — the reference truncates early rows at bucket
+                # granularity, ring_flash_attention.py:95-103)
+                max_ring_passes = math.ceil((lookback - 1) / n_chunk) + 1
+            # striped layout: windows are exact too (per-hop band lower
+            # offsets, parallel/ring.py), but striping interleaves tokens
+            # so every hop holds some in-window keys — all passes run.
+            # Prefer non-striped for windowed attention: the window itself
+            # balances causal load and allows hop skipping.
+        return bucket, bidirectional, window, max_ring_passes
 
     def _zigzag_attend(self, q, k, v, segment_ids=None):
         ring_size = self._ring_size()
@@ -340,36 +417,62 @@ class RingAttention(nn.Module):
             check_vma=not self._use_pallas(),
         )(q, k, v, mask, segment_ids)
 
+    def _hybrid_attend(self, q, k, v, mask, segment_ids=None):
+        """Ulysses x Ring 2-D factoring over the (data, ring, ulysses) mesh.
+
+        Rotary runs on the resident (pre-all-to-all) shard with positions
+        from the combined rank — the all-to-all only *moves* rotated
+        tokens, so the ring leg sees exactly the positions a pure ring of
+        ``ring_size`` devices would.  The ring-leg knobs (bucket, window,
+        bidirectional streams) are sized against the post-all-to-all chunk
+        ``n / ring_size``, which is what the ring actually attends.
+        """
+        ulysses = self._ulysses_size()
+        ring_size = self._ring_size() // ulysses
+        n = q.shape[2]
+        n_local = n // (ulysses * ring_size)  # resident shard
+        n_ring = n // ring_size  # post-all-to-all ring chunk
+        bucket, bidirectional, window, max_ring_passes = self._ring_leg(n_ring)
+
+        def core(q, k, v, mask, seg):
+            if self.rotary:
+                pos = hybrid_positions(
+                    n_local,
+                    lax.axis_index(ULYSSES_AXIS),
+                    lax.axis_index(RING_AXIS),
+                    ulysses=ulysses, ring=ring_size, striped=self.striped,
+                )
+                freqs = rotary_freqs(pos, self.dim_head, self.rotary_theta)
+                q_r = apply_rotary(q, freqs)
+                k_r = apply_rotary(k, freqs)
+            else:
+                q_r, k_r = q, k
+            return hybrid_attention(
+                q_r, k_r, v, mask, ULYSSES_AXIS, RING_AXIS,
+                causal=self.causal, striped=self.striped,
+                bucket_size=bucket, max_ring_passes=max_ring_passes,
+                window=window, softclamp_value=self.softclamp_value,
+                impl="pallas" if self._use_pallas() else "xla",
+                bidirectional=bidirectional,
+                dkv_dtype=self.ring_dkv_dtype,
+                segment_ids=seg,
+            )
+
+        qspec = P(DATA_AXIS, None, seq_partition(self.mesh), None)
+        mspec = self._seg_spec(mask)
+        return compat.shard_map(
+            core,
+            mesh=self.mesh,
+            in_specs=(qspec, qspec, qspec, mspec, self._seg_spec(segment_ids)),
+            out_specs=qspec,
+            check_vma=not self._use_pallas(),
+        )(q, k, v, mask, segment_ids)
+
     def _ring_attend(self, q, k, v, mask, segment_ids=None):
         ring_size = self._ring_size()
         n = q.shape[2]
         n_local = n // ring_size
-        # per-hop flash tile: largest divisor of the local shard <= bucket_size
-        bucket = min(self.bucket_size, n_local)
-        while n_local % bucket:
-            bucket -= 1
-
-        bidirectional = self._bidirectional(n_local)
-        max_ring_passes = None
-        window = None
-        lookback = self.max_lookback_seq_len
-        if lookback is not None:
-            assert self.causal, (
-                "max_lookback_seq_len requires causal attention "
-                "(ref ring_flash_attention.py:99)"
-            )
-            window = lookback
-            if not self.striped:
-                # contiguous layout: distant hops carry no in-window keys, so
-                # cover ceil((window-1)/n_local) earlier shards plus our own
-                # (exact — the reference truncates early rows at bucket
-                # granularity, ring_flash_attention.py:95-103)
-                max_ring_passes = math.ceil((lookback - 1) / n_local) + 1
-            # striped layout: windows are exact too (per-hop band lower
-            # offsets, parallel/ring.py), but striping interleaves tokens so
-            # every hop holds some in-window keys — all passes run.  Prefer
-            # non-striped for windowed attention: the window itself balances
-            # causal load and allows hop skipping.
+        bucket, bidirectional, window, max_ring_passes = self._ring_leg(n_local)
 
         def core(q, k, v, mask, seg):
             rank = lax.axis_index(SEQ_AXIS)
@@ -598,6 +701,12 @@ class RingAttention(nn.Module):
         is invisible under causal masking (pad keys sit after every real
         query) and padded output rows are sliced off.
         """
+        if is_factored(self.mesh):
+            raise NotImplementedError(
+                "ring-sharded prefill/decode runs on a plain (data, seq) "
+                "mesh; the factored hybrid mesh is a training/forward "
+                "layout — decode with create_mesh(ring_size=...)"
+            )
         ring_size = self._ring_size()
         n = q.shape[2]
         pad = (-n) % ring_size
@@ -639,6 +748,12 @@ class RingAttention(nn.Module):
         return out[:, :, :n]
 
     def _ring_decode(self, q, k, v, cache_k, cache_v, pos):
+        if is_factored(self.mesh):
+            raise NotImplementedError(
+                "ring-sharded decode runs on a plain (data, seq) mesh; the "
+                "factored hybrid mesh is a training/forward layout — decode "
+                "with create_mesh(ring_size=...)"
+            )
         ring_size = self._ring_size()
         quant = self.quantize_cache
         n_local = (cache_k[0] if quant else cache_k).shape[2] // ring_size

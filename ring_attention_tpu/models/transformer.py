@@ -29,9 +29,20 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import PAD_SEGMENT_ID
-from ..parallel.mesh import DATA_AXIS, SEQ_AXIS
-from ..parallel.sharding import pad_to_multiple, stripe_permute, stripe_unpermute
-from ..parallel.zigzag import zigzag_permute, zigzag_unpermute
+from ..parallel.mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    ULYSSES_AXIS,
+    is_factored,
+    seq_partition,
+    seq_world,
+)
+from ..parallel.sharding import (
+    layout_for,
+    layout_permute,
+    layout_unpermute,
+    pad_to_multiple,
+)
 from ..utils.validate import check_tokens_input
 from .attention import RingAttention
 from .layers import FeedForward, RMSNorm
@@ -93,7 +104,9 @@ class RingTransformer(nn.Module):
     # The cache is a ring buffer (writes at pos % size); exactness is
     # untouched because those layers never attend past their window
     windowed_cache: bool = False
-    sequence_parallel: str = "ring"  # "ring" | "zigzag" | "ulysses"
+    # "ring" | "zigzag" | "ulysses" | "hybrid" (Ulysses x Ring factored
+    # mesh, create_mesh(ulysses_size=U) — see docs/hybrid_parallelism.md)
+    sequence_parallel: str = "ring"
     ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
     ring_dkv_dtype: str | None = None  # see RingAttention.ring_dkv_dtype
     # rematerialize each block in backward: trades recompute for activation
@@ -181,9 +194,24 @@ class RingTransformer(nn.Module):
         self.to_logits = nn.Dense(self.num_tokens, use_bias=False, dtype=self.dtype)
 
     def _ring_size(self) -> int:
+        """Total sequence-parallel world (both axes of a factored mesh)."""
         if self.mesh is None or not self.use_ring or self.force_regular_attn:
             return 1
-        return self.mesh.shape[SEQ_AXIS]
+        return seq_world(self.mesh)
+
+    def _ulysses_size(self) -> int:
+        if self.mesh is None or not is_factored(self.mesh):
+            return 1
+        return self.mesh.shape[ULYSSES_AXIS]
+
+    def _layout(self) -> tuple[str, int]:
+        """(scheme, factor) of the model-top sequence permutation — the
+        shared derivation (``parallel/sharding.py::layout_for``), so the
+        model top and every attention layer agree by construction."""
+        return layout_for(
+            self.sequence_parallel, self.striped, self._ring_size(),
+            self._ulysses_size(),
+        )
 
     def _lookbacks(self) -> tuple[int | None, ...]:
         lb = self.max_lookback_seq_len
@@ -230,7 +258,7 @@ class RingTransformer(nn.Module):
 
         ring = self._ring_size()
         n_orig = tokens.shape[1]
-        striped = self.striped and ring > 1 and self.sequence_parallel == "ring"
+        scheme, factor = self._layout()
         zigzag = self.sequence_parallel == "zigzag" and ring > 1
         if zigzag:
             assert self.causal, "zig-zag CP is causal-only"
@@ -246,34 +274,29 @@ class RingTransformer(nn.Module):
                 # padded output rows are sliced off below.
                 mask = jnp.arange(tokens.shape[1])[None, :] < n_orig
                 mask = jnp.broadcast_to(mask, tokens.shape)
-            if striped:
-                tokens = stripe_permute(tokens, ring)
-            elif zigzag:
-                tokens = zigzag_permute(tokens, ring)
+            tokens = layout_permute(tokens, scheme, factor)
             tokens = lax.with_sharding_constraint(
-                tokens, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS))
+                tokens, NamedSharding(
+                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh))
+                )
             )
             if mask is not None:
                 mask, _ = pad_to_multiple(mask, pad_mult, value=False)
-                if striped:
-                    mask = stripe_permute(mask, ring)
-                elif zigzag:
-                    mask = zigzag_permute(mask, ring)
+                mask = layout_permute(mask, scheme, factor)
             if segment_ids is not None:
                 # pad slots get PAD_SEGMENT_ID: their own "document",
                 # attending nothing real (models/attention.py does the
                 # same for its per-layer padding)
                 segment_ids, _ = pad_to_multiple(segment_ids, pad_mult,
                                                  value=PAD_SEGMENT_ID)
-                if striped:
-                    segment_ids = stripe_permute(segment_ids, ring)
-                elif zigzag:
-                    segment_ids = zigzag_permute(segment_ids, ring)
+                segment_ids = layout_permute(segment_ids, scheme, factor)
 
         x = self.embed(tokens)
         if ring > 1 and self.auto_shard:
             x = lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS, None))
+                x, NamedSharding(
+                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh), None)
+                )
             )
 
         for attn, ff in zip(self.attn_layers, self.ff_layers):
@@ -288,10 +311,7 @@ class RingTransformer(nn.Module):
             # layout permutation only has to line features up with labels)
             # and scan the projection+CE over sequence chunks
             if ring > 1 and self.auto_shard:
-                if striped:
-                    x = stripe_unpermute(x, ring)
-                elif zigzag:
-                    x = zigzag_unpermute(x, ring)
+                x = layout_unpermute(x, scheme, factor)
                 x = x[:, :n_orig]
             return self._chunked_ce(
                 x, labels,
@@ -301,10 +321,7 @@ class RingTransformer(nn.Module):
         logits = self.to_logits(x)
 
         if ring > 1 and self.auto_shard:
-            if striped:
-                logits = stripe_unpermute(logits, ring)
-            elif zigzag:
-                logits = zigzag_unpermute(logits, ring)
+            logits = layout_unpermute(logits, scheme, factor)
             logits = logits[:, :n_orig]
 
         if not return_loss:
@@ -389,6 +406,12 @@ class RingTransformer(nn.Module):
         model dtype."""
         ring = self._ring_size()
         assert max_len % max(ring, 1) == 0
+        if ring > 1 and self.mesh is not None and is_factored(self.mesh):
+            raise NotImplementedError(
+                "ring-sharded decode runs on a plain (data, seq) mesh; the "
+                "factored hybrid mesh is a training/forward layout — decode "
+                "with create_mesh(ring_size=...)"
+            )
         if self.windowed_cache:
             assert ring <= 1, (
                 "windowed_cache is a local-decode optimization; the "

@@ -36,6 +36,34 @@ def ring_positions(n_local: int, rank: jax.Array | int, *, striped: bool, world:
     return i + rank * n_local
 
 
+def hybrid_positions(
+    n_local: int,
+    ulysses_rank: jax.Array | int,
+    ring_rank: jax.Array | int,
+    *,
+    ulysses: int,
+    ring: int,
+    striped: bool,
+) -> jax.Array:
+    """Global token positions for one shard of a factored ``seq = ulysses
+    x ring`` layout (``parallel/hybrid.py``).
+
+    The sequence dimension shards ring-major / ulysses-minor: ring rank
+    ``r`` owns chunk ``r`` of ``ring`` chunks and ulysses rank ``u`` owns
+    subchunk ``u`` within it, so local index ``i`` sits at in-chunk index
+    ``u * n_local + i`` — equivalently, combined rank ``r * ulysses + u``
+    of a ``ring * ulysses``-way contiguous sharding.  Striping (for the
+    causal ring's load balance) interleaves at the OUTER ring degree only:
+    in-chunk index ``j`` of ring rank ``r`` is global token ``j * ring +
+    r``, exactly the layout ``stripe_permute(x, ring)`` + factored
+    sharding produces.
+    """
+    j = ulysses_rank * n_local + jnp.arange(n_local)
+    if striped:
+        return j * ring + ring_rank
+    return ring_rank * (ulysses * n_local) + j
+
+
 def rotary_freqs(positions: jax.Array, dim: int, theta: float = 10000.0) -> jax.Array:
     """Angles ``(n, dim)`` for NeoX-style (half-rotation) rotary embedding."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))

@@ -1,0 +1,130 @@
+"""Hybrid Ulysses x Ring 2-D sequence parallelism.
+
+**Beyond reference parity**: the reference implements only 1-D context
+parallelism (ring / zig-zag over one flat world).  Factoring the sequence
+axis as ``seq = ulysses x ring`` matches each collective to its link tier
+(TASP, arXiv 2509.26541; TokenRing, arXiv 2412.20501): the bandwidth-heavy
+but latency-flat all-to-all runs over the *inner* ``ulysses`` axis (the
+fastest-connected device groups — intra-node ICI), while the
+latency-chained ring runs over the *outer* ``ring`` axis with
+``ulysses_size`` x fewer hops than a pure ring at equal world size.
+Per-device memory (O(n/world) KV resident, one circulating block) and
+exact-attention semantics are unchanged.
+
+Layout contract (``parallel/mesh.py::seq_axes``): the sequence dimension
+shards ring-major / ulysses-minor — device ``(u, r)`` of a
+``(data, ring, ulysses)`` mesh holds subchunk ``u`` of contiguous ring
+chunk ``r``.  The all-to-all over ``ulysses`` (tiled, heads split / seq
+concat) therefore reassembles exactly ring chunk ``r`` on every member of
+the group, and the existing :func:`~.ring.ring_flash_attention` runs
+unmodified over the ``ring`` sub-axis on that head subset.  Striped
+(balanced-causal) layouts interleave at the OUTER ring degree only —
+``stripe_permute(x, ring_size)`` — so the ring leg sees its usual striped
+band math with ``world == ring_size``.
+
+Composition, not new math: both legs already differentiate (the all-to-all
+through its transpose, the ring through its ``custom_vjp``), so this module
+is custom-vjp-free.  GQA with ``hk < ulysses_size`` rides
+:func:`~.ulysses.kv_head_reshard` — the real heads transfer once and expand
+locally, and the ring then circulates only the device's (deduplicated)
+kv-head block.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..ops.attention import normalize_segment_ids
+from ..utils import compat
+from ..utils.validate import check_attention_args
+from .ring import ring_flash_attention
+from .ulysses import kv_head_reshard
+
+
+def hybrid_attention(
+    q: jax.Array,  # (b, h, n_local, d), sequence-sharded over both axes
+    k: jax.Array,  # (b, hk, n_local, d)
+    v: jax.Array,
+    kv_mask: jax.Array | None,  # (b, n_local) key-padding shard
+    ulysses_axis: str,
+    ring_axis: str,
+    *,
+    causal: bool = False,
+    striped: bool = False,
+    bucket_size: int | None = None,
+    max_ring_passes: int | None = None,
+    window: int | None = None,
+    softclamp_value: float | None = None,
+    scale: float | None = None,
+    impl: str = "xla",
+    bidirectional: bool = False,
+    dkv_dtype: str | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """2-D factored sequence-parallel exact attention; call inside
+    ``shard_map`` over a ``(data, ring, ulysses)`` mesh (``ulysses``
+    innermost — the fastest-varying device dimension carries the
+    all-to-all).
+
+    Three stages per layer:
+
+    1. all-to-all q/k/v over the inner ``ulysses_axis``: each device trades
+       its sequence subchunk for a head subset — ``h / U`` query heads over
+       the full ring chunk (``U x`` the local sequence).
+    2. :func:`~.ring.ring_flash_attention` over the outer ``ring_axis`` on
+       that head subset — ``ring_size`` hops instead of ``U * ring_size``.
+    3. all-to-all back to the sequence-sharded layout.
+
+    ``kv_mask`` and ``segment_ids`` are per-token, so the inner leg
+    all-gathers them (cheap: ``(b, n)`` ints) to the ring-chunk extent; the
+    ring leg then circulates the kv copies per hop exactly as in the pure
+    ring, including the segment-overlap hop skip.
+
+    ``striped`` refers to the OUTER ring layout (stripe factor
+    ``ring_size``); rotary positions must already be applied by the caller
+    (``ops/rotary.py::hybrid_positions`` computes them from the combined
+    rank).  All remaining knobs (``window`` / ``max_ring_passes`` /
+    ``bidirectional`` / ``dkv_dtype`` / ``impl``) pass straight through to
+    the ring leg and mean what they mean there, with ``n_local`` read as
+    the post-all-to-all chunk (``U x`` the resident shard).
+
+    Returns the ``(b, h, n_local, d)`` output shard, in ``q.dtype``.
+    """
+    check_attention_args("hybrid_attention", q, k, v, kv_mask, equal_qkv_len=True)
+    segment_ids, _ = normalize_segment_ids(
+        None if segment_ids is None else (segment_ids, segment_ids),
+        q, q, "hybrid_attention",
+    )
+    b, h, n_local, d = q.shape
+    ulysses = compat.axis_size(ulysses_axis)
+    assert h % ulysses == 0, (
+        f"query heads {h} must divide over the {ulysses}-device ulysses axis"
+    )
+
+    # inner leg: seq-sharded -> head-sharded over ulysses.  (b, h/U, U*n, d)
+    qh = lax.all_to_all(q, ulysses_axis, split_axis=1, concat_axis=2, tiled=True)
+    kh, vh = kv_head_reshard(k, v, ulysses_axis, h)
+    mask_c = (
+        lax.all_gather(kv_mask, ulysses_axis, axis=1, tiled=True)
+        if kv_mask is not None
+        else None
+    )
+    seg_c = (
+        lax.all_gather(segment_ids, ulysses_axis, axis=1, tiled=True)
+        if segment_ids is not None
+        else None
+    )
+
+    # outer leg: the existing ring over the sub-axis, on the head subset
+    out = ring_flash_attention(
+        qh, kh, vh, mask_c, ring_axis,
+        causal=causal, striped=striped, bucket_size=bucket_size,
+        max_ring_passes=max_ring_passes, window=window,
+        softclamp_value=softclamp_value, scale=scale, impl=impl,
+        bidirectional=bidirectional, dkv_dtype=dkv_dtype,
+        segment_ids=seg_c,
+    )
+
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(out, ulysses_axis, split_axis=2, concat_axis=1, tiled=True)

@@ -17,42 +17,72 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+# factored (hybrid Ulysses x Ring) sequence axes: the sequence dimension is
+# sharded over BOTH, ring-major / ulysses-minor, so each ulysses group of U
+# devices collectively holds one contiguous ring chunk and the all-to-all
+# over ``ulysses`` reassembles exactly that chunk (parallel/hybrid.py)
+ULYSSES_AXIS = "ulysses"
+RING_AXIS = "ring"
 
 
 def create_mesh(
     ring_size: int | None = None,
     data_size: int | None = None,
     *,
+    ulysses_size: int | None = None,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a ``(data, seq)`` mesh.
+    """Build a ``(data, seq)`` mesh — or ``(data, ring, ulysses)`` when
+    ``ulysses_size`` factors the sequence axis for hybrid 2-D sequence
+    parallelism (``sequence_parallel="hybrid"``).
 
     ``ring_size`` defaults to all devices (one big ring); ``data_size``
     defaults to ``n_devices // ring_size`` — the reference's
     ``num_sharded_batches`` derivation (ref ``ring_attention.py:636-638``).
+    With ``ulysses_size=U``, ``ring_size`` is the OUTER ring degree and the
+    sequence-parallel world is ``U * ring_size``.
 
     On real TPU topologies the device order comes from
     ``mesh_utils.create_device_mesh`` so the ``seq`` (ring) axis maps onto
     physically adjacent ICI links — the per-hop ppermute then never crosses
     DCN.  This replaces the reference's flat-rank assumption (its NCCL ring
-    order is whatever the launcher provided).
+    order is whatever the launcher provided).  In the factored mesh the
+    ``ulysses`` axis is the innermost (fastest-varying) array dimension, so
+    the bandwidth-hungry all-to-all lands on the fastest-connected device
+    groups and the ring's per-hop ppermute rides the next tier out — the
+    TASP/TokenRing collective-to-link-tier matching (PAPERS.md).
     """
     explicit = devices is not None
     devices = devices if explicit else jax.devices()
     n = len(devices)
-    if ring_size is None:
-        ring_size = n if data_size is None else n // data_size
-    if data_size is None:
-        data_size = n // ring_size
-    assert data_size * ring_size == n, (
-        f"mesh {data_size}x{ring_size} != {n} devices"
-    )
+    if ulysses_size is not None and ulysses_size > 1:
+        u = ulysses_size
+        assert n % u == 0, f"ulysses_size {u} must divide {n} devices"
+        if ring_size is None:
+            ring_size = (n // u) if data_size is None else n // (data_size * u)
+        if data_size is None:
+            data_size = n // (u * ring_size)
+        assert data_size * u * ring_size == n, (
+            f"mesh {data_size}x{u}x{ring_size} != {n} devices"
+        )
+        shape = (data_size, ring_size, u)
+        axes = (DATA_AXIS, RING_AXIS, ULYSSES_AXIS)
+    else:
+        if ring_size is None:
+            ring_size = n if data_size is None else n // data_size
+        if data_size is None:
+            data_size = n // ring_size
+        assert data_size * ring_size == n, (
+            f"mesh {data_size}x{ring_size} != {n} devices"
+        )
+        shape = (data_size, ring_size)
+        axes = (DATA_AXIS, SEQ_AXIS)
     if not explicit and devices and devices[0].platform == "tpu":
         try:
             from jax.experimental import mesh_utils
 
-            arr = mesh_utils.create_device_mesh((data_size, ring_size))
-            return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+            arr = mesh_utils.create_device_mesh(shape)
+            return Mesh(arr, axes)
         except (ValueError, NotImplementedError) as e:
             import warnings
 
@@ -60,8 +90,39 @@ def create_mesh(
                 f"topology-aware device mesh unavailable ({e}); falling back "
                 "to flat device order — ring hops may cross non-adjacent links"
             )
-    arr = np.asarray(devices).reshape(data_size, ring_size)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def is_factored(mesh: Mesh) -> bool:
+    """True when the mesh factors the sequence axis (hybrid Ulysses x Ring)."""
+    return RING_AXIS in mesh.shape
+
+
+def seq_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axis name(s) the sequence dimension shards over, major first.
+
+    Plain meshes: ``("seq",)``.  Factored meshes: ``("ring", "ulysses")`` —
+    ring-major so device ``(u, r)`` holds subchunk ``u`` of contiguous ring
+    chunk ``r``, the layout the hybrid all-to-all reassembles.
+    """
+    if is_factored(mesh):
+        return (RING_AXIS, ULYSSES_AXIS)
+    return (SEQ_AXIS,)
+
+
+def seq_world(mesh: Mesh) -> int:
+    """Total number of sequence shards (the sequence-parallel world size)."""
+    size = 1
+    for ax in seq_axes(mesh):
+        size *= mesh.shape[ax]
+    return size
+
+
+def seq_partition(mesh: Mesh):
+    """PartitionSpec entry for the sequence dimension (axis name or tuple)."""
+    axes = seq_axes(mesh)
+    return axes[0] if len(axes) == 1 else axes
 
 
 def initialize_multihost(**kwargs) -> None:
@@ -79,8 +140,9 @@ def initialize_multihost(**kwargs) -> None:
 
 
 def seq_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for ``(b, n, ...)`` activations: batch over data, seq over ring."""
-    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    """Sharding for ``(b, n, ...)`` activations: batch over data, seq over
+    the ring — or over ``(ring, ulysses)`` on a factored (hybrid) mesh."""
+    return NamedSharding(mesh, P(DATA_AXIS, seq_partition(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

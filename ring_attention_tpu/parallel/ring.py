@@ -87,6 +87,12 @@ def _ring_perm(axis_name: str, shift: int = 1) -> list[tuple[int, int]]:
 
 
 def _rotate(x, axis_name: str, shift: int = 1):
+    # size-1 axes arise from degenerate hybrid factorings (ulysses == world
+    # on a factored mesh): the identity rotation is a real collective on
+    # some backends, so skip it rather than trust DCE (axis sizes are
+    # static, so this resolves at trace time)
+    if compat.axis_size(axis_name) == 1:
+        return x
     return lax.ppermute(x, axis_name, _ring_perm(axis_name, shift))
 
 
@@ -494,7 +500,14 @@ def ring_flash_attention(
         in backward), so every hop masks cross-document pairs and hops
         whose circulating block shares no document id range with the
         local queries skip their compute entirely.
-      axis_name: mesh axis the sequence is sharded over.
+      axis_name: mesh axis the sequence is sharded over.  May be a
+        *sub-axis* of a larger factored mesh (hybrid Ulysses x Ring,
+        ``parallel/hybrid.py``): every size used by the band offsets, the
+        hop permutations, and the backward catch-up rotation derives from
+        ``axis_size(axis_name)`` — never from the global device count — so
+        the ring stays correct when other mesh axes shard heads or batch
+        around it.  Striped layouts must be interleaved at exactly this
+        axis's size.
       causal/striped: causal masking, with striped (balanced) layout if the
         sequence was stripe-permuted before sharding.
       bucket_size: flash tile size within a hop.

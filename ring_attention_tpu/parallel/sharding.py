@@ -79,3 +79,70 @@ def stripe_unpermute(x: jax.Array, ring_size: int, axis: int = 1) -> jax.Array:
     x = x.reshape(new_shape)
     x = jnp.swapaxes(x, axis, axis + 1)
     return x.reshape(shape)
+
+
+def layout_for(
+    sequence_parallel: str,
+    striped: bool,
+    seq_world: int,
+    ulysses_size: int,
+) -> tuple[str, int]:
+    """``(scheme, factor)`` of the model-top sequence permutation for one
+    context-parallel strategy.
+
+    The ONE derivation both ``RingAttention`` and ``RingTransformer``
+    consult, so the model-top layout can never de-synchronize from the
+    per-layer band math.  The factor is the degree the layout interleaves
+    at: the full sequence-parallel world for the 1-D schemes, but only the
+    OUTER ring degree for hybrid — the ulysses all-to-all reassembles
+    contiguous ring chunks, so striping must balance ring ranks, not
+    devices.
+    """
+    if seq_world <= 1:
+        return "contiguous", 1
+    if sequence_parallel == "zigzag":
+        return "zigzag", seq_world
+    if not striped:
+        return "contiguous", seq_world
+    if sequence_parallel == "hybrid":
+        return "striped", seq_world // ulysses_size
+    if sequence_parallel == "ring":
+        return "striped", seq_world
+    return "contiguous", seq_world  # ulysses: no striping
+
+
+def layout_permute(x: jax.Array, scheme: str, factor: int) -> jax.Array:
+    """Apply the sequence-layout permutation one auto-shard scheme needs.
+
+    The ONE place the scheme -> permutation mapping lives (the model-top
+    auto-shard in ``models/attention.py`` and ``models/transformer.py``
+    both route through here, for tokens, masks, and segment ids alike), so
+    a factored (hybrid) layout only has to get its ``factor`` — the OUTER
+    ring degree, not the full sequence-parallel world — right once.
+
+    ``scheme``: ``"contiguous"`` (identity), ``"striped"`` (token-granular
+    stripe over ``factor`` ring ranks), or ``"zigzag"`` (Llama-3 chunk
+    pairing over ``factor`` ranks).
+    """
+    if scheme == "contiguous":
+        return x
+    if scheme == "striped":
+        return stripe_permute(x, factor)
+    if scheme == "zigzag":
+        from .zigzag import zigzag_permute
+
+        return zigzag_permute(x, factor)
+    raise ValueError(f"unknown sequence layout scheme {scheme!r}")
+
+
+def layout_unpermute(x: jax.Array, scheme: str, factor: int) -> jax.Array:
+    """Inverse of :func:`layout_permute`."""
+    if scheme == "contiguous":
+        return x
+    if scheme == "striped":
+        return stripe_unpermute(x, factor)
+    if scheme == "zigzag":
+        from .zigzag import zigzag_unpermute
+
+        return zigzag_unpermute(x, factor)
+    raise ValueError(f"unknown sequence layout scheme {scheme!r}")

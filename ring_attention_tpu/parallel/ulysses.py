@@ -17,8 +17,6 @@ kernels underneath (``impl="xla" | "pallas"``), differentiable through
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -28,6 +26,61 @@ from ..ops.attention import normalize_segment_ids
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import pallas_flash_attention
 from ..utils.validate import check_attention_args
+
+
+def kv_head_reshard(
+    k: jax.Array,  # (b, hk, n_local, d), sequence-sharded
+    v: jax.Array,
+    axis_name: str,
+    h: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Reshard K/V from sequence-sharded to head-sharded over ``axis_name``.
+
+    ``hk % world == 0``: a plain tiled all-to-all — each device ends up
+    with ``hk / world`` kv heads over the full axis-local sequence.
+
+    Small-hk GQA (``hk % world != 0``, typically ``hk < world``): the old
+    path repeated kv heads up to the axis size and all-to-all'ed the
+    copies, paying ``world / gcd(hk, world)`` x the real KV bytes on the
+    wire.  Instead, transfer the real ``hk`` heads exactly once — an
+    all-gather along the sequence — and expand to this device's head block
+    *locally* after the collective.  The backward stays correct with no
+    custom vjp: the local expand transposes to a scatter-add over the
+    copies and the all-gather transposes to a psum-scatter, so dk/dv sum
+    over every consumer (the reference's GQA grad-reduce contract, ref
+    ``ring_flash_attention.py:86-89,370-371``).
+
+    Returns ``(k, v)`` shaped ``(b, hk_local, world * n_local, d)`` where
+    the local query-head block ``[rank * h/world, (rank+1) * h/world)``
+    maps onto ``hk_local`` via the standard grouped convention
+    (``q head j -> kv head j // (h_local // hk_local)``).
+    """
+    hk = k.shape[1]
+    world = compat.axis_size(axis_name)
+    if hk % world == 0:
+        kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        return kh, vh
+    assert h % world == 0, f"query heads {h} must divide over {world} devices"
+    g = h // hk  # query heads per kv head
+    hql = h // world  # query heads per device
+    rank = lax.axis_index(axis_name)
+    k_full = lax.all_gather(k, axis_name, axis=2, tiled=True)
+    v_full = lax.all_gather(v, axis_name, axis=2, tiled=True)
+    if hql <= g and g % hql == 0:
+        # every query head on this device shares ONE kv head (hk divides
+        # world): slice it — the ulysses flash (and any downstream ring)
+        # then reads/circulates exactly one head's worth of KV
+        start = (rank * hql) // g
+        kh = lax.dynamic_slice_in_dim(k_full, start, 1, axis=1)
+        vh = lax.dynamic_slice_in_dim(v_full, start, 1, axis=1)
+    else:
+        # unaligned group boundaries: one kv copy per local query head
+        # (group size 1) — always correct, duplicates only within a device
+        idx = (rank * hql + jnp.arange(hql)) // g
+        kh = jnp.take(k_full, idx, axis=1)
+        vh = jnp.take(v_full, idx, axis=1)
+    return kh, vh
 
 
 def ulysses_attention(
@@ -49,13 +102,17 @@ def ulysses_attention(
 
     Requires ``h % world == 0`` (each device takes ``h/world`` query heads
     against the full sequence).  When ``hk`` does not divide over the axis
-    (small-hk GQA), KV heads are auto-repeated up to the axis size — grads
-    sum back over the copies.  Sequence layout is contiguous (no striping
-    needed — head parallelism is inherently balanced under causal masking).
+    (small-hk GQA), the real KV heads transfer once and repeat locally —
+    grads sum back over the copies.  Sequence layout is contiguous (no
+    striping needed — head parallelism is inherently balanced under causal
+    masking).
 
     ``segment_ids``: optional ``(b, n_local)`` int document-id shard for
     packed sequences; all-gathered (like ``kv_mask``) since each device
     attends the full sequence after the all-to-all.
+
+    Small-hk GQA (``hk % world != 0``) ships the real ``hk`` heads once
+    and expands locally after the collective — see :func:`kv_head_reshard`.
     """
     check_attention_args("ulysses_attention", q, k, v, kv_mask, equal_qkv_len=True)
     segment_ids, _ = normalize_segment_ids(
@@ -63,34 +120,12 @@ def ulysses_attention(
         q, q, "ulysses_attention",
     )
     b, h, n_local, d = q.shape
-    hk = k.shape[1]
     world = compat.axis_size(axis_name)
     assert h % world == 0, f"query heads {h} must divide over {world} devices"
 
-    if hk % world:
-        # GQA with fewer KV heads than the axis size: repeat each KV head
-        # r times so heads divide over the devices.  jnp.repeat keeps copies
-        # of head i contiguous, so query heads [i*g, (i+1)*g) still map onto
-        # copies of their own KV head after the all-to-all head split; the
-        # transpose of the repeat sums dk/dv back over the copies (the
-        # reference's GQA grad-reduce contract,
-        # ref ring_flash_attention.py:86-89,370-371).
-        gcd = math.gcd(hk, world)
-        r = world // gcd
-        g = h // hk
-        assert g % r == 0, (
-            f"cannot serve GQA with {hk} kv heads on a {world}-device ulysses "
-            f"axis: repeating kv heads x{r} needs the group size {g} to be a "
-            f"multiple of {r}"
-        )
-        k = jnp.repeat(k, r, axis=1)
-        v = jnp.repeat(v, r, axis=1)
-        hk = hk * r
-
     # seq-sharded -> head-sharded: (b, h/W, n_global, d)
     qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kh, vh = kv_head_reshard(k, v, axis_name, h)
     mask_full = (
         lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
         if kv_mask is not None

@@ -52,6 +52,23 @@ def test_train_example_smoke():
 
 
 @pytest.mark.slow
+def test_train_example_hybrid():
+    """--ulysses-size trains with the factored (data, ring, ulysses) mesh
+    end-to-end (hybrid 2-D sequence parallelism + packing)."""
+    out = _run_example(
+        "train.py", "--fake-devices", "8", "--steps", "3",
+        "--seq-len", "64", "--dim", "32", "--batch", "2",
+        "--ulysses-size", "2", "--pack",
+    )
+    assert "'ring': 4" in out and "'ulysses': 2" in out, out[-1500:]
+    losses = [
+        float(line.split("loss")[1].split()[0])
+        for line in out.splitlines() if "loss" in line
+    ]
+    assert losses and all(np.isfinite(losses)), losses
+
+
+@pytest.mark.slow
 def test_train_example_accum_remat_chunked_ce():
     out = _run_example(
         "train.py", "--fake-devices", "8", "--steps", "2",

@@ -45,7 +45,7 @@ def bench_records():
     # per-mode try/except so one mode's crash still reports the others
     for mode, impl in (
         ("fwd", "xla"), ("fwdbwd", "xla"), ("train", "xla"),
-        ("decode", "pallas"),
+        ("decode", "pallas"), ("hybrid", "pallas"),
     ):
         argv = ["bench.py", "--worker", impl, "1024", mode]
         lines += [
@@ -71,8 +71,8 @@ def bench_records():
         json.loads(ln) for ln in proc.stdout.strip().splitlines()
         if ln.startswith("{")
     ]
-    assert len(recs) == 4, proc.stdout[-500:]
-    return dict(zip(("fwd", "fwdbwd", "train", "decode"), recs))
+    assert len(recs) == 5, proc.stdout[-500:]
+    return dict(zip(("fwd", "fwdbwd", "train", "decode", "hybrid"), recs))
 
 
 @pytest.mark.slow
@@ -98,6 +98,17 @@ def test_bench_worker_decode(bench_records):
     rec = bench_records["decode"]
     assert rec["decode_ms_per_token"] > 0 and rec["decode_kv_gbps"] > 0
     assert rec["decode_impl"] == "pallas"
+
+
+@pytest.mark.slow
+def test_bench_worker_hybrid(bench_records):
+    """Hybrid Ulysses x Ring hop-sequence mode: the hybrid262k entry's
+    worker must report the shortened hop chain next to tokens/sec."""
+    rec = bench_records["hybrid"]
+    assert rec["impl"] == "pallas-hybrid"
+    assert rec["ulysses"] == 2 and rec["ring"] == 2
+    assert rec["hops"] == 1 and rec["pure_ring_hops"] == 3
+    assert rec["tokens_per_sec"] > 0
 
 
 @pytest.mark.slow

@@ -76,6 +76,18 @@ def test_tpu_kernel_validate_segments_flag_parses():
     assert "--segments" in proc.stdout
 
 
+def test_tpu_kernel_validate_hybrid_flag_parses():
+    """``--hybrid U`` (the Ulysses x Ring factoring sweep) must be a real
+    flag — same contract as ``--segments``: a broken flag is otherwise
+    only discovered when a scarce TPU window opens."""
+    proc = subprocess.run(
+        [sys.executable, KERNEL_VALIDATE, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--hybrid" in proc.stdout
+
+
 # ----------------------------------------------------------------------
 # Watcher lock protocol (the advisor's race, exercised for real)
 # ----------------------------------------------------------------------

@@ -82,8 +82,9 @@ def test_ulysses_head_divisibility(rng, mesh):
 @pytest.mark.parametrize("hk", [2, 4])
 def test_ulysses_gqa_auto_repeat(rng, mesh, hk):
     """GQA with hk < world (the flagship GQA shape that used to hard-fail):
-    KV heads auto-repeat up to the axis size; outputs AND k/v grads (summed
-    back over the copies) match the oracle."""
+    the real KV heads transfer once and expand locally after the
+    collective; outputs AND k/v grads (summed back over the copies) match
+    the oracle."""
     q, k, v = make_qkv(rng, h=16, hk=hk)
     ref = default_attention(q, k, v, causal=True)
     out = ulysses_global(q, k, v, mesh, causal=True, bucket_size=16)
@@ -98,3 +99,23 @@ def test_ulysses_gqa_auto_repeat(rng, mesh, hk):
     )(q, k, v)
     for a, b, name in zip(g_out, g_ref, "qkv"):
         np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+def test_ulysses_gqa_no_repeated_all_to_all(rng, mesh):
+    """Bandwidth pin for the small-hk fix: the collective layer must move
+    the real kv heads once, never world/gcd repeated copies.  Optimized
+    HLO holds exactly two all-to-alls (q to head-sharded, out back) and
+    two kv all-gathers — a reintroduced repeat-then-all-to-all shows up as
+    four all-to-alls and zero gathers."""
+    import re
+
+    q, k, v = make_qkv(rng, h=16, hk=2)
+    fn = jax.jit(
+        lambda q, k, v: ulysses_global(q, k, v, mesh, causal=True,
+                                       bucket_size=16)
+    )
+    txt = fn.lower(q, k, v).compile().as_text()
+    a2a = len(re.findall(r"%all-to-all[.\d]* = ", txt))
+    gather = len(re.findall(r"%all-gather[.\d]* = ", txt))
+    assert a2a == 2, f"expected 2 all-to-alls (q, out), found {a2a}"
+    assert gather == 2, f"expected 2 kv all-gathers, found {gather}"

@@ -46,6 +46,14 @@ def main() -> None:
                          "documents — parity vs the per-document oracle, "
                          "compact-grid tile counts (trace-time doc skip), "
                          "and timed fwd packed vs plain causal")
+    ap.add_argument("--hybrid", type=int, default=None, metavar="U",
+                    help="hybrid Ulysses x Ring sweep: for every factoring "
+                         "(u, r) of the available devices with u <= U, "
+                         "oracle parity of the 2-D factored attention at "
+                         "the small shape plus a timed fwd at --seq — on a "
+                         "multi-chip slice this measures the real "
+                         "all-to-all + shortened-ring collectives "
+                         "(docs/hybrid_parallelism.md)")
     args = ap.parse_args()
 
     import jax
@@ -178,6 +186,116 @@ def main() -> None:
                 "note": "seq must split into N block-aligned docs for the "
                         "tile accounting",
             }))
+
+    # ---- hybrid Ulysses x Ring sweep (--hybrid U): parity + timed fwd at
+    # each factoring of the available devices.  u == 1 is the pure-ring
+    # baseline the other rows are read against; each row reports its ring
+    # hop count so the hop-chain shrinkage is visible next to the timing.
+    if args.hybrid:
+        from functools import partial
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ring_attention_tpu.parallel import (
+            create_mesh,
+            hybrid_attention,
+            ring_flash_attention,
+            seq_partition,
+        )
+        from ring_attention_tpu.utils.compat import shard_map
+
+        n_dev = len(jax.devices())
+        factorings = [
+            (u, n_dev // u)
+            for u in range(1, min(args.hybrid, n_dev) + 1)
+            if n_dev % u == 0
+        ]
+        # the functional hybrid/ring entry points pick interpret mode from
+        # the platform, not per-call — so --interpret (the no-Mosaic
+        # preflight contract) routes the sweep through the XLA compute
+        # path instead; without it the real Mosaic kernels run on TPU
+        sweep_impl = "xla" if args.interpret else "pallas"
+        ksp = jax.random.split(jax.random.PRNGKey(3), 3)
+        qs = jax.random.normal(ksp[0], (1, h, n0, d), jnp.bfloat16)
+        ks_, vs = (
+            jax.random.normal(kk, (1, hk, n0, d), jnp.bfloat16)
+            for kk in ksp[1:]
+        )
+        oracle_s = default_attention(
+            qs.astype(jnp.float32), ks_.astype(jnp.float32),
+            vs.astype(jnp.float32), causal=True,
+        )
+        seq_flops = 2 * 2 * args.seq * args.seq * h * d * 0.5
+        for u, r in factorings:
+            if h % u:
+                print(json.dumps({
+                    "mode": "hybrid", "ulysses": u, "ring": r,
+                    "note": f"{h} heads do not divide over u={u}; skipped",
+                }))
+                continue
+            try:
+                mesh = (
+                    create_mesh(ulysses_size=u, ring_size=r, data_size=1)
+                    if u > 1 else create_mesh(ring_size=r, data_size=1)
+                )
+                spec = P("data", None, seq_partition(mesh), None)
+                if u > 1:
+                    core = partial(
+                        hybrid_attention, kv_mask=None,
+                        ulysses_axis="ulysses", ring_axis="ring",
+                        causal=True, impl=sweep_impl,
+                    )
+                else:
+                    core = partial(
+                        ring_flash_attention, kv_mask=None, axis_name="seq",
+                        causal=True, impl=sweep_impl,
+                    )
+                attn = shard_map(
+                    core, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                    check_vma=False,
+                )
+                err = float(jnp.abs(
+                    attn(qs, ks_, vs).astype(jnp.float32) - oracle_s
+                ).max())
+                print(json.dumps({
+                    "mode": "hybrid-parity", "ulysses": u, "ring": r,
+                    "impl": sweep_impl, "parity_seq": n0, "hops": r - 1,
+                    "max_err_vs_oracle": err,
+                }))
+
+                sharding = NamedSharding(mesh, spec)
+                kst = jax.random.split(jax.random.PRNGKey(4), 3)
+                qt = jax.device_put(jax.random.normal(
+                    kst[0], (1, h, args.seq, d), jnp.bfloat16), sharding)
+                kt = jax.device_put(jax.random.normal(
+                    kst[1], (1, hk, args.seq, d), jnp.bfloat16), sharding)
+                vt = jax.device_put(jax.random.normal(
+                    kst[2], (1, hk, args.seq, d), jnp.bfloat16), sharding)
+
+                @jax.jit
+                def chained(q, k, v, attn=attn):
+                    def body(c, _):
+                        o = attn(c, k, v)
+                        return c + 1e-3 * o.astype(c.dtype), o[0, 0, 0, 0]
+                    _, ys = jax.lax.scan(body, q, None, length=3)
+                    return ys.astype(jnp.float32).sum()
+
+                compile_s, secs = timed_chained(chained, (qt, kt, vt), 3)
+                print(json.dumps({
+                    "mode": "hybrid-fwd", "seq": args.seq,
+                    "ulysses": u, "ring": r, "hops": r - 1,
+                    "impl": sweep_impl,
+                    # 4 decimals: CPU-backend preflights land in the 1e-3
+                    # TFLOPs range and must not round to zero
+                    "tflops": round(seq_flops / secs / 1e12, 4),
+                    "ms": round(secs * 1e3, 1),
+                    "compile_s": round(compile_s, 1),
+                }))
+            except Exception as e:  # noqa: BLE001 - sweep survives rejects
+                print(json.dumps({
+                    "mode": "hybrid", "ulysses": u, "ring": r,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}",
+                }))
 
     # ---- timing at the target shape
     seq = args.seq
