@@ -93,6 +93,42 @@ def _timed(chained_fn, args, iters):
     return timed_chained(chained_fn, args, iters)
 
 
+def _cost_fields(chained, args, secs_per_iter, iters):
+    """Best-effort XLA cost analysis of the timed executable: the
+    compiler-counted FLOPs/bytes next to the analytic formula, plus the
+    achieved HBM bandwidth (``bytes accessed`` over the measured wall
+    time).  The lowering hits the jit cache, so this re-lower is cheap;
+    any failure returns ``{}`` — diagnostics never fail a measurement."""
+    try:
+        from ring_attention_tpu.utils.telemetry import compiled_cost
+
+        cost = compiled_cost(chained.lower(*args).compile())
+    except Exception:  # noqa: BLE001
+        return {}
+    out = {}
+    if cost.get("xla_flops"):
+        out["xla_flops"] = cost["xla_flops"]
+    if cost.get("bytes_accessed") and secs_per_iter > 0:
+        out["bytes_accessed"] = cost["bytes_accessed"]
+        # the executable runs `iters` chained iterations per call
+        out["hbm_gbps"] = round(
+            cost["bytes_accessed"] / (secs_per_iter * iters) / 1e9, 1
+        )
+    return out
+
+
+def _degradation_fields():
+    """Kernel-fallback record for this worker's JSON (utils/telemetry.py):
+    a run that silently lost its Pallas kernels must say so in the bench
+    output, not only in a scrolled-away warning."""
+    try:
+        from ring_attention_tpu.utils.telemetry import degradation_fields
+
+        return degradation_fields()
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     """Runs one timed measurement and prints its own JSON line.
 
@@ -194,6 +230,10 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
                 # and must not round to a zero measurement
                 "value": round(tflops, 4),
                 "vs_baseline": round(tflops / peak, 4),
+                # same number under its proper name (docs/observability.md)
+                "mfu": round(tflops / peak, 4),
+                **_cost_fields(chained, (q, k, v), secs, iters),
+                **_degradation_fields(),
                 "seq_len": seq_len,
                 "impl": impl,
                 "heads": heads,
@@ -298,6 +338,7 @@ def _hybrid_worker(seq_len: int, world: int, ulysses: int) -> None:
             {
                 "value": round(tflops, 4),
                 "vs_baseline": round(tflops / peak, 4),
+                "mfu": round(tflops / peak, 4),
                 "seq_len": seq_len,
                 "world": world,
                 "ulysses": ulysses,
@@ -364,6 +405,7 @@ def _hops_worker(seq_len: int, ring: int) -> None:
             {
                 "value": round(tflops, 4),
                 "vs_baseline": round(tflops / peak, 4),
+                "mfu": round(tflops / peak, 4),
                 "seq_len": seq_len,
                 "ring": ring,
                 "impl": "pallas-hops",
@@ -599,7 +641,7 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
     import jax.numpy as jnp
     import optax
 
-    dev, _ = _device_peak()
+    dev, peak = _device_peak()
     model, params = _bench_transformer(impl, vocab, remat_policy,
                                        loss_chunk_size)
     opt = optax.adam(1e-3)
@@ -634,6 +676,22 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
         chained, (params, opt_state, tokens), iters, return_value=True
     )
 
+    # achieved MFU of the whole step (fwd+bwd+adam): XLA's counted FLOPs
+    # when the backend reports them, the analytic transformer formula
+    # otherwise — next to tokens/sec so a regression says WHICH of
+    # "the model got slower" vs "the chip got slower" happened
+    from ring_attention_tpu.utils.telemetry import (
+        achieved_mfu, transformer_step_flops,
+    )
+
+    cost = _cost_fields(chained, (params, opt_state, tokens), secs, iters)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    step_flops = transformer_step_flops(
+        n_params, seq_len, depth=2, heads=HEADS, dim_head=DIM_HEAD,
+        seq_len=seq_len, causal=True,
+    )
+    if cost.get("xla_flops"):
+        step_flops = cost["xla_flops"] / iters
     print(
         json.dumps(
             {
@@ -647,6 +705,10 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
                 "train_ms_per_step": round(secs * 1e3, 2),
                 "train_compile_s": round(compile_s, 1),
                 "train_loss": round(float(loss), 4),
+                "train_mfu": round(achieved_mfu(step_flops, secs, peak), 4),
+                "train_flops_per_step": step_flops,
+                **cost,
+                **_degradation_fields(),
                 "device": getattr(dev, "device_kind", str(dev)),
             }
         )
@@ -709,6 +771,39 @@ def _last_measured() -> dict:
     except OSError:
         pass
     return latest
+
+
+def _log_probe_failure(probe: dict) -> None:
+    """Append a structured probe-failure row to the hardware results log.
+
+    BENCH_r04/r05's only trace of the wedge was a tail string inside the
+    bench JSON.  A ``probe_failure`` row in ``docs/hwlogs/results.jsonl``
+    (same record shape as the measurement rows; ``_last_measured`` skips
+    it — no ``value`` field) makes hang history queryable:
+    ``grep probe_failure docs/hwlogs/results.jsonl`` is the wedge
+    timeline.  ``BENCH_HWLOG`` overrides the path (tests point it at a
+    temp file so CI probe-failure exercises never touch the real log);
+    the single-line append is atomic for concurrent benches.
+    """
+    path = os.environ.get("BENCH_HWLOG") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "hwlogs", "results.jsonl",
+    )
+    rec = {
+        "step": "probe_failure",
+        "date": time.strftime("%Y-%m-%d"),
+        "result": {
+            "error": probe.get("error", "device probe failed"),
+            "cached": bool(probe.get("cached")),
+            **({"age_s": probe["age_s"]} if probe.get("cached") else {}),
+            "env": probe.get("env", ""),
+        },
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass  # the log is an archive; never fail the bench over it
 
 
 def _cached_probe(run_probe):
@@ -832,8 +927,14 @@ def main() -> None:
         result["probe_cached"] = True
         result["probe_age_s"] = probe.get("age_s")
     if not probe["ok"]:
-        result["error"] = probe.get("error", "device probe failed")
+        err = probe.get("error", "device probe failed")
+        if probe.get("cached"):
+            # the verdict's age belongs IN the error: "wedged 840s ago"
+            # and "wedged just now" direct different operator responses
+            err += f" [cached verdict, {probe.get('age_s', 0.0)}s old]"
+        result["error"] = err
         result["last_measured"] = _last_measured()
+        _log_probe_failure(probe)
         print(json.dumps(result))
         return
 

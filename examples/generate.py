@@ -42,6 +42,10 @@ def main() -> None:
     ap.add_argument("--compile-cache-dir", default=None,
                     help="persistent XLA compilation cache directory: "
                          "repeated runs skip recompiles (utils/benchtime.py)")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="telemetry: append decode-throughput JSONL rows "
+                         "(tok/s, ms/token, prefill length) for "
+                         "tools/trace_report.py (docs/observability.md)")
     args = ap.parse_args()
 
     if args.temperature <= 0.0 and (args.top_k is not None
@@ -89,6 +93,17 @@ def main() -> None:
     prompt = jnp.asarray(rng.integers(0, 256, (1, args.prompt_len)), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), prompt)
 
+    def log_decode(**fields):
+        if args.metrics_dir is None:
+            return
+        from ring_attention_tpu.utils import MetricsLogger
+
+        with MetricsLogger(args.metrics_dir) as logger:
+            logger.log(0, mode="decode", devices=n_dev,
+                       prompt_len=args.prompt_len,
+                       use_pallas=bool(args.use_pallas),
+                       q8_cache=bool(args.q8_cache), **fields)
+
     if args.temperature > 0.0:
         # whole loop as ONE compiled scan (models/transformer.py generate)
         t0 = time.perf_counter()
@@ -103,6 +118,9 @@ def main() -> None:
         print(f"devices={n_dev}  sampled {len(toks)} tokens in one "
               f"compile+scan ({len(toks) / dt:.1f} tok/s incl. compile)")
         print("tokens:", toks)
+        log_decode(tokens=len(toks), seconds=round(dt, 4),
+                   tokens_per_sec=round(len(toks) / dt, 2),
+                   sampled=True, compile_included=True)
         return
 
     # prefill once, then jit one decode step and stream
@@ -128,6 +146,11 @@ def main() -> None:
     print(f"devices={n_dev}  generated {len(toks)} tokens "
           f"({(len(toks) - 1) / dt:.1f} tok/s after prefill)")
     print("tokens:", toks)
+    if len(toks) > 1:
+        log_decode(tokens=len(toks), seconds=round(dt, 4),
+                   tokens_per_sec=round((len(toks) - 1) / dt, 2),
+                   ms_per_token=round(dt * 1e3 / (len(toks) - 1), 3),
+                   sampled=False, compile_included=False)
 
 
 if __name__ == "__main__":

@@ -74,7 +74,17 @@ def main() -> None:
                          "updates whose loss/grads are non-finite")
     ap.add_argument("--clip-grad-norm", type=float, default=None,
                     help="clip gradients to this global L2 norm")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="telemetry: write one schema-versioned JSONL row "
+                         "per --log-every window (loss, grad_norm, "
+                         "tokens_per_sec, step p50/p95, mfu, ring hop/byte "
+                         "accounting, skipped-step counts) — render with "
+                         "tools/trace_report.py (docs/observability.md)")
+    ap.add_argument("--log-every", type=int, default=5,
+                    help="steps between metric rows / console lines")
     args = ap.parse_args()
+    if args.log_every < 1:
+        ap.error("--log-every must be >= 1")
 
     if args.fake_devices:
         os.environ["XLA_FLAGS"] = (
@@ -93,11 +103,18 @@ def main() -> None:
     from ring_attention_tpu.parallel import shard_batch
     from ring_attention_tpu.utils import (
         CheckpointManager,
+        MetricsLogger,
         StepTimer,
+        achieved_mfu,
+        device_peak_tflops,
         enable_compile_cache,
         init_step_stats,
+        init_train_metrics,
         make_train_step,
+        ring_comms_accounting,
+        transformer_step_flops,
     )
+    from ring_attention_tpu.utils.train import StepStats
 
     if args.compile_cache_dir:
         # before any jit: every compile from here on lands in the cache
@@ -188,14 +205,18 @@ def main() -> None:
         batch = (tokens,)
 
     guarded = args.skip_nonfinite
+    collect = args.metrics_dir is not None
     # jit_donate: (params, opt_state) buffers are donated so XLA updates
-    # them in place instead of double-allocating model + Adam state
+    # them in place instead of double-allocating model + Adam state.
+    # collect_metrics extends the carry to TrainMetrics (loss, grad_norm,
+    # skipped/nonfinite counters) with no extra collectives in the step.
     train_step = make_train_step(
         loss_fn, opt,
         accum_steps=args.accum_steps,
         skip_nonfinite=guarded,
         clip_grad_norm=args.clip_grad_norm,
         jit_donate=True,
+        collect_metrics=collect,
     )
 
     # preemption-safe resume: atomic saves, keep-last-N, corrupt-checkpoint
@@ -204,40 +225,113 @@ def main() -> None:
     mgr = None
     start = 0
     stats = init_step_stats()
+    nonfinite = jnp.asarray(0, jnp.int32)
     if args.ckpt_dir:
         mgr = CheckpointManager(args.ckpt_dir, keep=args.ckpt_keep)
         # stats ride along in the checkpoint so a resumed guarded run
         # keeps its skipped-step telemetry (a growing skip streak is the
-        # "this run diverged" signal and must survive preemption)
-        state, start = mgr.resume_or_init(
-            lambda: {"params": params, "opt_state": opt_state,
+        # "this run diverged" signal and must survive preemption).  With
+        # metrics on, the nonfinite counter rides too — unguarded runs
+        # have skipped == 0, so losing it would silently reset the "run
+        # is corrupting itself" alarm across preemption.
+        def fresh():
+            state = {"params": params, "opt_state": opt_state,
                      "stats": stats}
-        )
+            if collect:
+                state["nonfinite"] = nonfinite
+            return state
+
+        state, start = mgr.resume_or_init(fresh)
         params, opt_state = state["params"], state["opt_state"]
         stats = state["stats"]
+        nonfinite = state.get("nonfinite", nonfinite)
         if start:
             print(f"resumed from checkpoint (continuing at step {start})")
+
+    # telemetry (docs/observability.md): the instrumented step carries
+    # TrainMetrics; the logger writes one schema-versioned JSONL row per
+    # --log-every window, with MFU and ring-hop/byte accounting computed
+    # analytically once (they derive from shapes and the mesh factoring)
+    metrics = None
+    logger = None
+    mfu_flops = 0.0
+    comms = {}
+    peak = device_peak_tflops() * max(n_dev, 1)
+    if collect:
+        # a resumed run continues its counters in the metrics carry
+        metrics = init_train_metrics(skipped=int(stats.skipped),
+                                     nonfinite=int(nonfinite))
+        logger = MetricsLogger(args.metrics_dir)
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        mfu_flops = transformer_step_flops(
+            n_params, tokens.size, depth=args.depth, heads=4,
+            dim_head=args.dim // 4, seq_len=args.seq_len, causal=True,
+            batch=args.batch,
+        )
+        if mesh is not None:
+            pad_seq = args.seq_len + (-args.seq_len) % seq_shards
+            comms = ring_comms_accounting(
+                ring_size=ring, ulysses_size=ulysses, seq_len=pad_seq,
+                heads=4, kv_heads=4, dim_head=args.dim // 4,
+                dtype_bytes=2 if args.bf16 else 4, batch=args.batch,
+                depth=args.depth,
+            )
+        else:
+            comms = {"ring_hops": 0, "ring_hops_per_step": 0, "hop_bytes": 0}
+
     timer = StepTimer(tokens_per_step=tokens.size)
     for step in range(start, args.steps):
-        if guarded:
+        if collect:
+            params, opt_state, metrics, loss = train_step(
+                params, opt_state, metrics, *batch
+            )
+            # checkpointed StepStats stays structure-compatible with
+            # uninstrumented runs; it mirrors the metrics counters
+            stats = StepStats(step_ok=metrics.step_ok,
+                              skipped=metrics.skipped)
+        elif guarded:
             params, opt_state, stats, loss = train_step(
                 params, opt_state, stats, *batch
             )
         else:
             params, opt_state, loss = train_step(params, opt_state, *batch)
         timer.step(loss)
-        if step % 5 == 0 or step == args.steps - 1:
-            skipped = int(stats.skipped) if guarded else 0
+        if step % args.log_every == 0 or step == args.steps - 1:
+            skipped = int(stats.skipped) if (guarded or collect) else 0
             print(
                 f"step {step:4d}  loss {float(loss):.4f}  "
                 f"{timer.tokens_per_sec:,.0f} tok/s"
                 + (f"  [skipped {skipped}]" if skipped else "")
             )
+            if logger is not None:
+                sps = timer.steps_per_sec
+                logger.log(
+                    step,
+                    loss=float(loss),
+                    grad_norm=float(metrics.grad_norm),
+                    step_ok=bool(metrics.step_ok),
+                    skipped=int(metrics.skipped),
+                    nonfinite=int(metrics.nonfinite),
+                    tokens_per_sec=round(timer.tokens_per_sec, 1),
+                    steps_per_sec=round(sps, 4),
+                    step_ms_p50=round(timer.step_ms_p50, 2),
+                    step_ms_p95=round(timer.step_ms_p95, 2),
+                    mfu=round(
+                        achieved_mfu(mfu_flops, 1.0 / sps, peak), 6
+                    ) if sps > 0 else 0.0,
+                    **comms,
+                )
         if mgr is not None and (
             step % args.ckpt_every == 0 or step == args.steps - 1
         ):
-            mgr.save(step, {"params": params, "opt_state": opt_state,
-                            "stats": stats})
+            ckpt = {"params": params, "opt_state": opt_state,
+                    "stats": stats}
+            if collect:
+                ckpt["nonfinite"] = metrics.nonfinite
+            mgr.save(step, ckpt)
+    if logger is not None:
+        logger.close()
+        print(f"metrics: {logger.path} (render with tools/trace_report.py)")
 
 
 if __name__ == "__main__":

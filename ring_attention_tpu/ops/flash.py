@@ -396,13 +396,16 @@ def _flash_core_bwd(scale, bucket_size, window, softclamp_value, res, do):
     q, k, v, kv_mask, q_seg, kv_seg, causal_offset, out, lse = res
     hk = k.shape[1]
     window_lo = causal_offset - (window - 1) if window is not None else None
-    delta = (_group_q(do, hk).astype(jnp.float32) * _group_q(out, hk).astype(jnp.float32)).sum(-1)
-    dq, dk, dv = flash_backward_blocks(
-        do, q, k, v, lse, delta,
-        scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
-        window_lo=window_lo, kv_mask=kv_mask, softclamp_value=softclamp_value,
-        q_segment_ids=q_seg, kv_segment_ids=kv_seg,
-    )
+    with jax.named_scope("flash/bwd"):
+        delta = (_group_q(do, hk).astype(jnp.float32)
+                 * _group_q(out, hk).astype(jnp.float32)).sum(-1)
+        dq, dk, dv = flash_backward_blocks(
+            do, q, k, v, lse, delta,
+            scale=scale, bucket_size=bucket_size, causal_offset=causal_offset,
+            window_lo=window_lo, kv_mask=kv_mask,
+            softclamp_value=softclamp_value,
+            q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+        )
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             None, None, None, None)
 
@@ -490,19 +493,21 @@ def flash_attention(
             xs["off"] = causal_offset + jnp.arange(nc, dtype=jnp.int32) * cq
 
         def body(_, xs):
-            return None, _flash_attention_core(
-                xs["q"], k, v, mask, xs.get("qs"), kv_seg, xs.get("off"),
-                scale, bucket_size, window, softclamp_value,
-            )
+            with jax.named_scope("flash/fwd"):
+                return None, _flash_attention_core(
+                    xs["q"], k, v, mask, xs.get("qs"), kv_seg, xs.get("off"),
+                    scale, bucket_size, window, softclamp_value,
+                )
 
         _, outs = lax.scan(body, None, xs)
 
         out = jnp.moveaxis(outs, 0, 2).reshape(b, h, nc * cq, d)
         return out[:, :, :nq] if pad_q else out
-    return _flash_attention_core(
-        q, k, v, mask, q_seg, kv_seg, causal_offset, scale, bucket_size,
-        window, softclamp_value,
-    )
+    with jax.named_scope("flash/fwd"):
+        return _flash_attention_core(
+            q, k, v, mask, q_seg, kv_seg, causal_offset, scale, bucket_size,
+            window, softclamp_value,
+        )
 
 
 def _pad_kv_to_bucket(q, k, v, mask, kv_seg, bucket_size):

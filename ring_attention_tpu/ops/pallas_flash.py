@@ -697,6 +697,7 @@ def _flash_fwd_call(
     scale, causal_offset, window_lo, softclamp_value,
     block_q, block_k, band_hint, interpret, fused, carry=None,
     exp2=None, q_segment_ids=None, kv_segment_ids=None, doc_starts=None,
+    name=None,
 ):
     """Shared forward launcher: one flash sweep over a KV span.
 
@@ -910,6 +911,13 @@ def _flash_fwd_call(
         ],
     )
 
+    # stable kernel names: XProf shows the Mosaic custom-call under this
+    # label, so traces attribute time to "which flash sweep" (resume = a
+    # ring hop continuing a carry) — docs/observability.md
+    if name is None:
+        name = "flash_fwd_tile" if fused else "flash_partials_tile"
+        if resume:
+            name += "_resume"
     results = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -918,6 +926,7 @@ def _flash_fwd_call(
             dimension_semantics=semantics
         ),
         interpret=interpret,
+        name=name,
     )(*scalars, *inputs)
 
     if fused:
@@ -1107,6 +1116,7 @@ def pallas_flash_decode(
         softclamp_value=softclamp_value,
         block_q=rows + pad, block_k=block_k or DEFAULT_BLOCK_DECODE,
         band_hint=None, interpret=interpret, fused=fused,
+        name="flash_decode",
     )
     if fused:
         out, lse = res
@@ -1317,6 +1327,7 @@ def pallas_flash_decode_q8(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
+        name="flash_decode_q8",
     )(*inputs)
 
     if fused:
@@ -1841,6 +1852,7 @@ def pallas_flash_backward(
             dimension_semantics=dkv_semantics
         ),
         interpret=interpret,
+        name="flash_bwd_dkv",
     )(*dkv_scalars, *inputs)
 
     # GQA: sum per-query-head dk/dv over the group
@@ -1909,6 +1921,7 @@ def pallas_flash_backward(
             dimension_semantics=dq_semantics
         ),
         interpret=interpret,
+        name="flash_bwd_dq",
     )(*dq_scalars, *inputs)
 
     if dq_post_scale != 1.0:

@@ -103,28 +103,38 @@ def hybrid_attention(
     )
 
     # inner leg: seq-sharded -> head-sharded over ulysses.  (b, h/U, U*n, d)
-    qh = lax.all_to_all(q, ulysses_axis, split_axis=1, concat_axis=2, tiled=True)
-    kh, vh = kv_head_reshard(k, v, ulysses_axis, h)
-    mask_c = (
-        lax.all_gather(kv_mask, ulysses_axis, axis=1, tiled=True)
-        if kv_mask is not None
-        else None
-    )
-    seg_c = (
-        lax.all_gather(segment_ids, ulysses_axis, axis=1, tiled=True)
-        if segment_ids is not None
-        else None
-    )
+    # Scope names split XProf time between the a2a legs and the inner ring
+    # (whose hops carry their own ring/hop{i} scopes nested under
+    # hybrid/inner — docs/observability.md).
+    with jax.named_scope("hybrid/a2a_in"):
+        qh = lax.all_to_all(
+            q, ulysses_axis, split_axis=1, concat_axis=2, tiled=True
+        )
+        kh, vh = kv_head_reshard(k, v, ulysses_axis, h)
+        mask_c = (
+            lax.all_gather(kv_mask, ulysses_axis, axis=1, tiled=True)
+            if kv_mask is not None
+            else None
+        )
+        seg_c = (
+            lax.all_gather(segment_ids, ulysses_axis, axis=1, tiled=True)
+            if segment_ids is not None
+            else None
+        )
 
     # outer leg: the existing ring over the sub-axis, on the head subset
-    out = ring_flash_attention(
-        qh, kh, vh, mask_c, ring_axis,
-        causal=causal, striped=striped, bucket_size=bucket_size,
-        max_ring_passes=max_ring_passes, window=window,
-        softclamp_value=softclamp_value, scale=scale, impl=impl,
-        bidirectional=bidirectional, dkv_dtype=dkv_dtype,
-        segment_ids=seg_c,
-    )
+    with jax.named_scope("hybrid/inner"):
+        out = ring_flash_attention(
+            qh, kh, vh, mask_c, ring_axis,
+            causal=causal, striped=striped, bucket_size=bucket_size,
+            max_ring_passes=max_ring_passes, window=window,
+            softclamp_value=softclamp_value, scale=scale, impl=impl,
+            bidirectional=bidirectional, dkv_dtype=dkv_dtype,
+            segment_ids=seg_c,
+        )
 
     # head-sharded -> seq-sharded
-    return lax.all_to_all(out, ulysses_axis, split_axis=2, concat_axis=1, tiled=True)
+    with jax.named_scope("hybrid/a2a_out"):
+        return lax.all_to_all(
+            out, ulysses_axis, split_axis=2, concat_axis=1, tiled=True
+        )

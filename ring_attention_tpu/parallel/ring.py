@@ -48,6 +48,13 @@ KV circulates as one or more *streams* (``_streams``): unidirectional is
 one whole-block stream; ``bidirectional=True`` splits the block into two
 halves ppermuted in opposite directions so per-hop transfers ride both
 directions of the full-duplex ICI links (``docs/ring_overlap.md``).
+
+Trace attribution (``docs/observability.md``): every hop's compute and
+rotation carry stable ``jax.named_scope`` names — ``ring/hop{i}`` /
+``ring/rotate{i}`` on the unrolled Pallas path (static hop index),
+``ring/hop`` / ``ring/rotate`` on the scanned XLA path, ``ring/bwd_hop*``
+and ``ring/catchup`` in backward — so an XProf capture splits device time
+between per-hop flash compute and the ppermute chain.
 """
 
 from __future__ import annotations
@@ -424,42 +431,44 @@ def _ring_fwd_pallas(
                     band_hint=hint, carry=c, segment_ids=seg_pair,
                 )
 
-            if span == n_spans - 1:
+            with jax.named_scope(f"ring/hop{i}"):
+                if span == n_spans - 1:
 
-                def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
-                         blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
-                    return pallas_flash_fused(
-                        q, kvx[0], kvx[1], mx,
-                        scale=scale, causal_offset=hi, window_lo=lo,
-                        softclamp_value=softclamp_value,
-                        block_q=blk_q, block_k=blk_k,
-                        # hint only rides along with a carry (see
-                        # pallas_flash_fused); by the last hop every row's
-                        # carry holds its own-diagonal content
-                        band_hint=hint if c is not None else None, carry=c,
-                        segment_ids=seg_pair,
-                    )
+                    def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
+                             blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
+                        return pallas_flash_fused(
+                            q, kvx[0], kvx[1], mx,
+                            scale=scale, causal_offset=hi, window_lo=lo,
+                            softclamp_value=softclamp_value,
+                            block_q=blk_q, block_k=blk_k,
+                            # hint only rides along with a carry (see
+                            # pallas_flash_fused); by the last hop every
+                            # row's carry holds its own-diagonal content
+                            band_hint=hint if c is not None else None,
+                            carry=c, segment_ids=seg_pair,
+                        )
 
-                if carry is None:  # ring of one: plain fused local sweep
-                    out, lse = fuse(None)
+                    if carry is None:  # ring of one: plain fused local sweep
+                        out, lse = fuse(None)
+                    else:
+
+                        def fin(c):
+                            o, s = finalize_partials(c)
+                            return o.astype(q.dtype), s
+
+                        out, lse = lax.cond(has_work, fuse, fin, carry)
+                elif carry is None:
+                    carry = partials(None)
                 else:
-
-                    def fin(c):
-                        o, s = finalize_partials(c)
-                        return o.astype(q.dtype), s
-
-                    out, lse = lax.cond(has_work, fuse, fin, carry)
-            elif carry is None:
-                carry = partials(None)
-            else:
-                carry = lax.cond(has_work, partials, lambda c: c, carry)
+                    carry = lax.cond(has_work, partials, lambda c: c, carry)
             span += 1
             if i < passes - 1:
-                new_kvs.append(_rotate(kvx, axis_name, stream[0]))
-                if mx is not None:
-                    new_masks.append(_rotate(mx, axis_name, stream[0]))
-                if sx is not None:
-                    new_segs.append(_rotate(sx, axis_name, stream[0]))
+                with jax.named_scope(f"ring/rotate{i}"):
+                    new_kvs.append(_rotate(kvx, axis_name, stream[0]))
+                    if mx is not None:
+                        new_masks.append(_rotate(mx, axis_name, stream[0]))
+                    if sx is not None:
+                        new_segs.append(_rotate(sx, axis_name, stream[0]))
         if i < passes - 1:
             kvs, masks, segs = (
                 tuple(new_kvs), tuple(new_masks), tuple(new_segs)
@@ -640,21 +649,23 @@ def _ring_fwd_impl(
             )
             has_work = _hop_has_work(hi, lo, n_local, stream[2],
                                      segment_ids, sx)
-            flash = lax.cond(
-                has_work,
-                lambda f, kvx=kvx, mx=mx, hi=hi, lo=lo, sx=sx: attend(
-                    f, kvx[0], kvx[1], mx, hi, lo, sx
-                ),
-                lambda f: f,
-                flash,
-            )
+            with jax.named_scope("ring/hop"):  # hop index is traced here
+                flash = lax.cond(
+                    has_work,
+                    lambda f, kvx=kvx, mx=mx, hi=hi, lo=lo, sx=sx: attend(
+                        f, kvx[0], kvx[1], mx, hi, lo, sx
+                    ),
+                    lambda f: f,
+                    flash,
+                )
             # rotate AFTER compute; collective outside the cond so the
             # schedule is uniform across devices
-            new_kvs.append(_rotate(kvx, axis_name, stream[0]))
-            if mx is not None:
-                new_masks.append(_rotate(mx, axis_name, stream[0]))
-            if sx is not None:
-                new_segs.append(_rotate(sx, axis_name, stream[0]))
+            with jax.named_scope("ring/rotate"):
+                new_kvs.append(_rotate(kvx, axis_name, stream[0]))
+                if mx is not None:
+                    new_masks.append(_rotate(mx, axis_name, stream[0]))
+                if sx is not None:
+                    new_segs.append(_rotate(sx, axis_name, stream[0]))
         return flash, tuple(new_kvs), tuple(new_masks), tuple(new_segs)
 
     def body(c, i):
@@ -725,6 +736,7 @@ def _ring_vjp_bwd(
     dq = match_vma(jnp.zeros((b, h, n_local, d), jnp.float32), q)
 
     def hop(i, dq, kvs, dkvs, masks, segs):
+        scope = f"ring/bwd_hop{i}" if isinstance(i, int) else "ring/bwd_hop"
         new_kvs, new_dkvs, new_masks, new_segs = [], [], [], []
         for si, stream in enumerate(streams):
             kvx, dkvx = kvs[si], dkvs[si]
@@ -756,13 +768,15 @@ def _ring_vjp_bwd(
                     .at[1].add(dv_i.astype(dkvx.dtype))
                 )
 
-            dq, dkvx = lax.cond(has_work, do_bwd, lambda a: a, (dq, dkvx))
-            new_kvs.append(_rotate(kvx, axis_name, stream[0]))
-            new_dkvs.append(_rotate(dkvx, axis_name, stream[0]))
-            if mx is not None:
-                new_masks.append(_rotate(mx, axis_name, stream[0]))
-            if sx is not None:
-                new_segs.append(_rotate(sx, axis_name, stream[0]))
+            with jax.named_scope(scope):
+                dq, dkvx = lax.cond(has_work, do_bwd, lambda a: a, (dq, dkvx))
+            with jax.named_scope("ring/rotate"):
+                new_kvs.append(_rotate(kvx, axis_name, stream[0]))
+                new_dkvs.append(_rotate(dkvx, axis_name, stream[0]))
+                if mx is not None:
+                    new_masks.append(_rotate(mx, axis_name, stream[0]))
+                if sx is not None:
+                    new_segs.append(_rotate(sx, axis_name, stream[0]))
         return (dq, tuple(new_kvs), tuple(new_dkvs), tuple(new_masks),
                 tuple(new_segs))
 
@@ -786,11 +800,14 @@ def _ring_vjp_bwd(
     # a single collective (the reference loops single hops instead,
     # ref ring_flash_attention.py:380-385).
     caught = []
-    for stream, dkvx in zip(streams, dkvs):
-        shift = (stream[0] * (ring_size - passes)) % ring_size
-        if shift:
-            dkvx = lax.ppermute(dkvx, axis_name, _ring_perm(axis_name, shift))
-        caught.append(dkvx)
+    with jax.named_scope("ring/catchup"):
+        for stream, dkvx in zip(streams, dkvs):
+            shift = (stream[0] * (ring_size - passes)) % ring_size
+            if shift:
+                dkvx = lax.ppermute(
+                    dkvx, axis_name, _ring_perm(axis_name, shift)
+                )
+            caught.append(dkvx)
 
     if len(caught) == 1:
         dkv = caught[0]

@@ -107,35 +107,38 @@ def tree_attn_decode(
             k, v = dequantize_kv_cache(kv_quantized, q.dtype)
             kv_quantized = None
 
-    if kv_quantized is not None:
-        acc, m, l = pallas_flash_decode_q8(
-            q, kv_quantized, kv_mask,
-            scale=scale, softclamp_value=softclamp_value,
-            block_k=bucket_size, fused=False,
-        )
-    elif impl == "pallas":
-        check_attention_args("tree_attn_decode", q, k, v, kv_mask)
-        acc, m, l = pallas_flash_decode(
-            q, k, v, kv_mask,
-            scale=scale, softclamp_value=softclamp_value,
-            block_k=bucket_size, fused=False,
-        )
-    else:
-        check_attention_args("tree_attn_decode", q, k, v, kv_mask)
-        hk = k.shape[1]
-        g = h // hk
-        carry = init_carry(b, hk, g, nq, d, like=k)
-        carry = attend_blocks(
-            q, k, v, carry,
-            scale=scale, bucket_size=bucket_size, kv_mask=kv_mask,
-            softclamp_value=softclamp_value,
-        )
-        acc, m, l = carry
+    with jax.named_scope("tree_decode/local"):
+        if kv_quantized is not None:
+            acc, m, l = pallas_flash_decode_q8(
+                q, kv_quantized, kv_mask,
+                scale=scale, softclamp_value=softclamp_value,
+                block_k=bucket_size, fused=False,
+            )
+        elif impl == "pallas":
+            check_attention_args("tree_attn_decode", q, k, v, kv_mask)
+            acc, m, l = pallas_flash_decode(
+                q, k, v, kv_mask,
+                scale=scale, softclamp_value=softclamp_value,
+                block_k=bucket_size, fused=False,
+            )
+        else:
+            check_attention_args("tree_attn_decode", q, k, v, kv_mask)
+            hk = k.shape[1]
+            g = h // hk
+            carry = init_carry(b, hk, g, nq, d, like=k)
+            carry = attend_blocks(
+                q, k, v, carry,
+                scale=scale, bucket_size=bucket_size, kv_mask=kv_mask,
+                softclamp_value=softclamp_value,
+            )
+            acc, m, l = carry
 
-    # three-collective merge (ref tree_attn_decoding.py:89-100)
-    m_global = lax.pmax(m, axis_name)
-    correction = jnp.exp(m - m_global)
-    num = lax.psum(acc * correction[..., None], axis_name)
-    den = lax.psum(l * correction, axis_name)
-    out = num / jnp.maximum(den, EPSILON)[..., None]
+    # three-collective merge (ref tree_attn_decoding.py:89-100); the
+    # scope is the decode step's collective cost in an XProf capture
+    with jax.named_scope("tree_decode/gather"):
+        m_global = lax.pmax(m, axis_name)
+        correction = jnp.exp(m - m_global)
+        num = lax.psum(acc * correction[..., None], axis_name)
+        den = lax.psum(l * correction, axis_name)
+        out = num / jnp.maximum(den, EPSILON)[..., None]
     return _ungroup(out).astype(q.dtype)

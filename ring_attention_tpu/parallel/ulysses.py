@@ -123,32 +123,41 @@ def ulysses_attention(
     world = compat.axis_size(axis_name)
     assert h % world == 0, f"query heads {h} must divide over {world} devices"
 
-    # seq-sharded -> head-sharded: (b, h/W, n_global, d)
-    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    kh, vh = kv_head_reshard(k, v, axis_name, h)
-    mask_full = (
-        lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
-        if kv_mask is not None
-        else None
-    )
-    seg_full = (
-        lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
-        if segment_ids is not None
-        else None
-    )
+    # seq-sharded -> head-sharded: (b, h/W, n_global, d).  Stable scope
+    # names attribute XProf time to the a2a legs vs the local flash
+    # (docs/observability.md).
+    with jax.named_scope("ulysses/a2a_in"):
+        qh = lax.all_to_all(
+            q, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+        kh, vh = kv_head_reshard(k, v, axis_name, h)
+        mask_full = (
+            lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+            if kv_mask is not None
+            else None
+        )
+        seg_full = (
+            lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+            if segment_ids is not None
+            else None
+        )
 
-    if impl == "pallas":
-        out = pallas_flash_attention(
-            qh, kh, vh, mask_full, causal=causal, window=window,
-            softclamp_value=softclamp_value, scale=scale,
-            segment_ids=seg_full,
-        )
-    else:
-        out = flash_attention(
-            qh, kh, vh, mask_full, causal=causal, bucket_size=bucket_size,
-            window=window, softclamp_value=softclamp_value, scale=scale,
-            segment_ids=seg_full,
-        )
+    with jax.named_scope("ulysses/flash"):
+        if impl == "pallas":
+            out = pallas_flash_attention(
+                qh, kh, vh, mask_full, causal=causal, window=window,
+                softclamp_value=softclamp_value, scale=scale,
+                segment_ids=seg_full,
+            )
+        else:
+            out = flash_attention(
+                qh, kh, vh, mask_full, causal=causal, bucket_size=bucket_size,
+                window=window, softclamp_value=softclamp_value, scale=scale,
+                segment_ids=seg_full,
+            )
 
     # head-sharded -> seq-sharded
-    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    with jax.named_scope("ulysses/a2a_out"):
+        return lax.all_to_all(
+            out, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )

@@ -223,15 +223,18 @@ def zigzag_attention(
         )
 
     # gather K/V over sequence: (b, hk, n_global, d) in zig-zag shard order
-    k_all = lax.all_gather(k, axis_name, axis=2, tiled=True)
-    v_all = lax.all_gather(v, axis_name, axis=2, tiled=True)
-    # static un-permute back to canonical sequence order
-    k_all = zigzag_unpermute(k_all, ring_size, axis=2)
-    v_all = zigzag_unpermute(v_all, ring_size, axis=2)
-    seg_all = None
-    if segment_ids is not None:
-        seg_all = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
-        seg_all = zigzag_unpermute(seg_all, ring_size, axis=1)
+    with jax.named_scope("zigzag/gather"):
+        k_all = lax.all_gather(k, axis_name, axis=2, tiled=True)
+        v_all = lax.all_gather(v, axis_name, axis=2, tiled=True)
+        # static un-permute back to canonical sequence order
+        k_all = zigzag_unpermute(k_all, ring_size, axis=2)
+        v_all = zigzag_unpermute(v_all, ring_size, axis=2)
+        seg_all = None
+        if segment_ids is not None:
+            seg_all = lax.all_gather(
+                segment_ids, axis_name, axis=1, tiled=True
+            )
+            seg_all = zigzag_unpermute(seg_all, ring_size, axis=1)
 
     # flash tile over the gathered keys: largest divisor of the global length
     n_global = k_all.shape[2]
@@ -254,22 +257,23 @@ def zigzag_attention(
         )
         # causal band, end-aligned to the chunk's global end: local row i
         # (global start_expr + i) sees keys j <= start_expr + i
-        if impl == "pallas":
-            outs.append(
-                _pallas_chunk_attention(
-                    qc, k_all, v_all, qc_seg, seg_all, start_expr, scale,
-                    softclamp_value, bucket,
+        with jax.named_scope(f"zigzag/chunk{which}"):
+            if impl == "pallas":
+                outs.append(
+                    _pallas_chunk_attention(
+                        qc, k_all, v_all, qc_seg, seg_all, start_expr, scale,
+                        softclamp_value, bucket,
+                    )
                 )
-            )
-        else:
-            carry = init_carry(b, hk, g, chunk, d, like=qc)
-            carry = attend_blocks(
-                qc, k_all, v_all, carry,
-                scale=scale, bucket_size=bucket,
-                causal_offset=start_expr,
-                softclamp_value=softclamp_value,
-                q_segment_ids=qc_seg, kv_segment_ids=seg_all,
-            )
-            out_g, _ = finalize(carry)
-            outs.append(_ungroup(out_g))
+            else:
+                carry = init_carry(b, hk, g, chunk, d, like=qc)
+                carry = attend_blocks(
+                    qc, k_all, v_all, carry,
+                    scale=scale, bucket_size=bucket,
+                    causal_offset=start_expr,
+                    softclamp_value=softclamp_value,
+                    q_segment_ids=qc_seg, kv_segment_ids=seg_all,
+                )
+                out_g, _ = finalize(carry)
+                outs.append(_ungroup(out_g))
     return jnp.concatenate(outs, axis=2).astype(q.dtype)

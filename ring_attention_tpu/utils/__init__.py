@@ -5,8 +5,22 @@ from .checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
-from .profiling import StepTimer, trace
+from .profiling import StepTimer, annotate, trace
 from .benchtime import enable_compile_cache, fetch_rtt, timed_chained
+from .telemetry import (
+    MetricsLogger,
+    Telemetry,
+    TrainMetrics,
+    achieved_mfu,
+    attention_logit_summaries,
+    device_peak_tflops,
+    flash_attention_flops,
+    init_train_metrics,
+    read_metrics,
+    ring_comms_accounting,
+    telemetry,
+    transformer_step_flops,
+)
 from .train import StepStats, init_step_stats, make_train_step, shard_optimizer_state
 from .validate import check_attention_args, check_model_input, check_tokens_input
 
@@ -23,6 +37,19 @@ __all__ = [
     "CheckpointStructureError",
     "StepTimer",
     "trace",
+    "annotate",
+    "MetricsLogger",
+    "Telemetry",
+    "TrainMetrics",
+    "telemetry",
+    "init_train_metrics",
+    "read_metrics",
+    "achieved_mfu",
+    "attention_logit_summaries",
+    "device_peak_tflops",
+    "flash_attention_flops",
+    "transformer_step_flops",
+    "ring_comms_accounting",
     "check_attention_args",
     "check_model_input",
     "check_tokens_input",

@@ -2,14 +2,21 @@
 
 The reference has no timers or profiler hooks at all (SURVEY §5).  On TPU
 the platform profiler (XProf via ``jax.profiler``) is the ground truth for
-MXU utilization and ICI overlap; this module adds the two things a training
-loop actually calls: a trace context and a step-throughput meter.
+MXU utilization and ICI overlap; this module adds the pieces a training
+loop actually calls: a trace context, named annotations, and a
+step-throughput meter.  The hot paths across ``parallel/`` and ``ops/``
+are wrapped in stable ``jax.named_scope`` names (``ring/hop{i}``,
+``ulysses/a2a_in``, ``hybrid/inner``, ``tree_decode/gather``, …) so an
+XProf capture attributes device time to stages — ``tools/trace_report.py``
+renders the resulting per-stage table.
 """
 
 from __future__ import annotations
 
 import contextlib
+import statistics
 import time
+import warnings
 from dataclasses import dataclass, field
 
 import jax
@@ -29,24 +36,68 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+def annotate(name: str):
+    """Host-side timeline annotation (``jax.profiler.TraceAnnotation``).
+
+    Marks a span on the HOST trace line — dispatch loops, data loading,
+    checkpoint saves.  For naming *device* time inside jitted code use
+    ``jax.named_scope`` (applied throughout ``parallel/`` and ``ops/``);
+    the two compose: a host annotation around a ``step()`` call brackets
+    the device ops the named scopes attribute.
+
+    >>> with annotate("train/step"):
+    ...     loss = step(...)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
 @dataclass
 class StepTimer:
     """Wall-clock throughput meter for a training/decoding loop.
 
     Blocks on the supplied result each step so async dispatch doesn't hide
-    device time; reports steps/s and tokens/s over a sliding window.
+    device time; reports steps/s and tokens/s over a sliding window, plus
+    p50/p95 per-step latency (the tail is what a wedged collective or a
+    slow host callback shows up in first — the mean hides it).
+
+    Timestamps come from ``time.perf_counter`` (monotonic by contract); a
+    non-increasing reading anyway — a suspended VM, a broken clock shim —
+    resets the window instead of poisoning every rate until it scrolls
+    out (``clock_anomalies`` counts the resets).
     """
 
     tokens_per_step: int = 0
     window: int = 20
+    clock_anomalies: int = 0
     _times: list = field(default_factory=list)
+    _warned_no_tokens: bool = field(default=False, repr=False)
 
     def step(self, result=None) -> None:
         if result is not None:
             jax.block_until_ready(result)
-        self._times.append(time.perf_counter())
+            if self.tokens_per_step == 0 and not self._warned_no_tokens:
+                # tokens_per_sec would read 0.0 forever — say so ONCE
+                # instead of letting a dashboard trend a silent zero
+                self._warned_no_tokens = True
+                warnings.warn(
+                    "StepTimer.step() called with a result but "
+                    "tokens_per_step is unset — tokens_per_sec will report "
+                    "0.0; construct StepTimer(tokens_per_step=...) to get "
+                    "throughput",
+                    stacklevel=2,
+                )
+        now = time.perf_counter()
+        if self._times and now <= self._times[-1]:
+            self.clock_anomalies += 1
+            self._times.clear()
+        self._times.append(now)
         if len(self._times) > self.window + 1:
             self._times.pop(0)
+
+    def _deltas(self) -> list[float]:
+        return [
+            b - a for a, b in zip(self._times, self._times[1:])
+        ]
 
     @property
     def steps_per_sec(self) -> float:
@@ -58,3 +109,28 @@ class StepTimer:
     @property
     def tokens_per_sec(self) -> float:
         return self.steps_per_sec * self.tokens_per_step
+
+    @property
+    def step_ms_p50(self) -> float:
+        """Median per-step latency (ms) over the window; 0.0 until two
+        steps have been recorded."""
+        deltas = self._deltas()
+        if not deltas:
+            return 0.0
+        return statistics.median(deltas) * 1e3
+
+    @property
+    def step_ms_p95(self) -> float:
+        """95th-percentile per-step latency (ms) over the window (linear
+        interpolation; equals the max for windows under ~20 steps)."""
+        deltas = self._deltas()
+        if not deltas:
+            return 0.0
+        if len(deltas) == 1:
+            return deltas[0] * 1e3
+        deltas = sorted(deltas)
+        pos = 0.95 * (len(deltas) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(deltas) - 1)
+        frac = pos - lo
+        return (deltas[lo] * (1 - frac) + deltas[hi] * frac) * 1e3

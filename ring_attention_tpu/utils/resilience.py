@@ -236,7 +236,18 @@ class DegradationRecord:
     def __init__(self) -> None:
         self._events: list[DegradationEvent] = []
         self._degraded: set[str] = set()
+        self._listeners: list[Callable[[str, str], None]] = []
         self._lock = threading.Lock()
+
+    def add_listener(self, callback: Callable[[str, str], None]) -> None:
+        """Register ``callback(component, reason)`` to run on every
+        recorded degradation (idempotent per callback).  This is how the
+        telemetry layer turns silent ``impl="auto"`` fallbacks into metric
+        rows without this module importing it (this file must stay
+        stdlib-only and loadable standalone — see bench.py)."""
+        with self._lock:
+            if callback not in self._listeners:
+                self._listeners.append(callback)
 
     def record(self, component: str, reason: BaseException | str) -> None:
         text = f"{type(reason).__name__}: {reason}" if isinstance(
@@ -246,6 +257,12 @@ class DegradationRecord:
             first = component not in self._degraded
             self._degraded.add(component)
             self._events.append(DegradationEvent(component, text))
+            listeners = tuple(self._listeners)
+        for cb in listeners:
+            try:
+                cb(component, text)
+            except Exception:  # noqa: BLE001 — telemetry must never break
+                pass           # the degradation path it observes
         if first:
             warnings.warn(
                 f"resilience: {component} degraded, falling back "

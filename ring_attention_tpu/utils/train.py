@@ -57,6 +57,7 @@ def make_train_step(
     skip_nonfinite: bool = False,
     clip_grad_norm: float | None = None,
     jit_donate: bool = False,
+    collect_metrics: bool = False,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
@@ -93,6 +94,18 @@ def make_train_step(
       double-allocating — at long context the Adam moments are the next
       HBM cliff after activations.  Callers jitting by hand should pass
       ``donate_argnums=(0, 1)`` themselves.
+    - ``collect_metrics=True`` — the instrumented step
+      (``utils/telemetry.py``): the signature becomes
+      ``step(params, opt_state, metrics, *batch) ->
+      (params, opt_state, metrics, loss)`` where ``metrics`` is a
+      :class:`~.telemetry.TrainMetrics` carry seeded by
+      :func:`~.telemetry.init_train_metrics` holding this step's loss and
+      pre-clip global gradient norm plus running skipped/nonfinite
+      counters.  Composes with ``skip_nonfinite`` (the metrics carry then
+      *replaces* the ``StepStats`` argument — it is a superset).  Every
+      metric derives from values the step already computes, so
+      instrumentation adds no collectives to the compiled program
+      (pinned by ``tests/test_telemetry.py``).
     """
     if accum_steps < 1:
         raise ValueError(f"make_train_step: accum_steps must be >= 1, got {accum_steps}")
@@ -137,8 +150,17 @@ def make_train_step(
             )
             loss = loss_sum * inv
 
+        # one global norm serves clipping, the non-finite guard, AND the
+        # metrics carry: any NaN/inf in any leaf propagates into it, and
+        # clipping by a finite factor keeps non-finite values non-finite,
+        # so checking the pre-clip norm is equivalent to post-clip
+        gnorm = (
+            optax.global_norm(grads)
+            if (clip_grad_norm is not None or skip_nonfinite
+                or collect_metrics)
+            else None
+        )
         if clip_grad_norm is not None:
-            gnorm = optax.global_norm(grads)
             clip = jnp.minimum(
                 1.0, clip_grad_norm / jnp.maximum(gnorm, 1e-12)
             )
@@ -148,7 +170,7 @@ def make_train_step(
 
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, loss, grads
+        return new_params, new_opt_state, loss, gnorm
 
     def finish(step):
         if not jit_donate:
@@ -157,7 +179,7 @@ def make_train_step(
 
         return compat.jit(step, donate_argnums=(0, 1))
 
-    if not skip_nonfinite:
+    if not skip_nonfinite and not collect_metrics:
 
         def step(params, opt_state, *batch):
             new_params, new_opt_state, loss, _ = compute_update(
@@ -167,14 +189,9 @@ def make_train_step(
 
         return finish(step)
 
-    def guarded_step(params, opt_state, stats: StepStats, *batch):
-        new_params, new_opt_state, loss, grads = compute_update(
-            params, opt_state, *batch
-        )
-        # one scalar covers every gradient leaf: any NaN/inf propagates
-        # into the global norm (and clipping keeps non-finite values
-        # non-finite, so the check composes with clip_grad_norm)
-        ok = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+    def apply_or_skip(ok, new_params, new_opt_state, params, opt_state):
+        if not skip_nonfinite:
+            return new_params, new_opt_state
 
         def keep_old(new, old):
             return jax.tree.map(
@@ -184,15 +201,56 @@ def make_train_step(
         # jnp.where with the old value on the skip branch is bit-identical
         # (no arithmetic touches the kept params) — the property the
         # fault-injection suite asserts
-        params = keep_old(new_params, params)
-        opt_state = keep_old(new_opt_state, opt_state)
-        stats = StepStats(
-            step_ok=ok,
-            skipped=stats.skipped + jnp.where(ok, 0, 1).astype(jnp.int32),
+        return (
+            keep_old(new_params, params), keep_old(new_opt_state, opt_state)
         )
-        return params, opt_state, stats, loss
 
-    return finish(guarded_step)
+    if not collect_metrics:
+
+        def guarded_step(params, opt_state, stats: StepStats, *batch):
+            new_params, new_opt_state, loss, gnorm = compute_update(
+                params, opt_state, *batch
+            )
+            # one scalar covers every gradient leaf: any NaN/inf propagates
+            # into the global norm (see compute_update)
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            params, opt_state = apply_or_skip(
+                ok, new_params, new_opt_state, params, opt_state
+            )
+            stats = StepStats(
+                step_ok=ok,
+                skipped=stats.skipped + jnp.where(ok, 0, 1).astype(jnp.int32),
+            )
+            return params, opt_state, stats, loss
+
+        return finish(guarded_step)
+
+    from .telemetry import TrainMetrics
+
+    def metric_step(params, opt_state, metrics: TrainMetrics, *batch):
+        new_params, new_opt_state, loss, gnorm = compute_update(
+            params, opt_state, *batch
+        )
+        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+        # step_ok reports whether the update was APPLIED: without the
+        # guard every step applies; nonfinite still counts the poison
+        ok = finite if skip_nonfinite else jnp.asarray(True)
+        params, opt_state = apply_or_skip(
+            finite, new_params, new_opt_state, params, opt_state
+        )
+        one = jnp.asarray(1, jnp.int32)
+        zero = jnp.asarray(0, jnp.int32)
+        metrics = TrainMetrics(
+            loss=loss.astype(jnp.float32),
+            grad_norm=gnorm.astype(jnp.float32),
+            step_ok=ok,
+            skipped=metrics.skipped
+            + (jnp.where(finite, zero, one) if skip_nonfinite else zero),
+            nonfinite=metrics.nonfinite + jnp.where(finite, zero, one),
+        )
+        return params, opt_state, metrics, loss
+
+    return finish(metric_step)
 
 
 def shard_optimizer_state(

@@ -501,6 +501,10 @@ def test_bench_probe_failure_emits_wedge_honest_json(tmp_path):
     env["JAX_PLATFORMS"] = "nonexistent_backend"
     env["BENCH_PROBE_ATTEMPTS"] = "1"
     env["BENCH_PROBE_BACKOFF_S"] = "0"
+    # hermetic hwlog: the probe-failure row must not land in the repo's
+    # real docs/hwlogs/results.jsonl from a CI exercise
+    hwlog = os.path.join(str(tmp_path), "results.jsonl")
+    env["BENCH_HWLOG"] = hwlog
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
@@ -510,6 +514,12 @@ def test_bench_probe_failure_emits_wedge_honest_json(tmp_path):
     assert payload["value"] == 0.0
     assert "error" in payload
     assert "last_measured" in payload
+    # the structured wedge-history row (telemetry satellite): same failure,
+    # queryable from the hardware log instead of a tail string
+    with open(hwlog) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    assert rows and rows[-1]["step"] == "probe_failure"
+    assert "error" in rows[-1]["result"]
 
 
 def test_impl_auto_input_error_does_not_degrade():

@@ -24,6 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = sorted(glob.glob(os.path.join(REPO, "tools", "*.sh")))
 WATCHER = os.path.join(REPO, "tools", "tpu_window_watch.sh")
 KERNEL_VALIDATE = os.path.join(REPO, "tools", "tpu_kernel_validate.py")
+TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
 
 
 def test_tools_exist():
@@ -86,6 +87,24 @@ def test_tpu_kernel_validate_hybrid_flag_parses():
     )
     assert proc.returncode == 0, proc.stderr
     assert "--hybrid" in proc.stdout
+
+
+def test_trace_report_compiles():
+    py_compile.compile(TRACE_REPORT, doraise=True)
+
+
+def test_trace_report_flags_parse():
+    """``trace_report.py`` is stdlib-only and its flag surface (``--xprof``
+    / ``--last``) must parse without any jax import — the telemetry
+    analogue of the kernel-validate smoke: a broken report tool is
+    otherwise only discovered when someone needs the numbers."""
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--xprof" in proc.stdout
+    assert "--last" in proc.stdout
 
 
 # ----------------------------------------------------------------------

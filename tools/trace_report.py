@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Render a telemetry run into per-metric and per-stage tables.
+
+Input is a metrics directory (or JSONL file) written by
+``examples/train.py --metrics-dir`` / ``MetricsLogger``
+(``docs/observability.md`` is the schema glossary), plus optionally an
+XProf capture directory (``tools/xprof_capture.py`` / ``utils.profiling
+.trace``).  Output:
+
+- run summary (rows, step span, schema version, degradation events);
+- per-metric table (last / mean / p50 / p95) over the numeric metric
+  columns — loss, grad_norm, tokens_per_sec, step latency, mfu;
+- comms accounting echo (ring hops, bytes per hop, overlap fraction);
+- when ``--xprof DIR`` points at a capture with ``*.xplane.pb`` planes, a
+  per-stage device-time table keyed on the stack's stable trace names
+  (``ring/hop*``, ``ulysses/*``, ``hybrid/*``, ``flash*``,
+  ``tree_decode/*``) — where the step's wall time actually went.
+
+Stdlib-only except the optional xplane proto parser (the same
+best-effort import as ``tools/xprof_capture.py``); parsing never fails
+the report.  Usage::
+
+  python tools/trace_report.py /tmp/m [--xprof docs/hwlogs/xprof/train]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+from collections import defaultdict
+
+# metric columns the table summarizes, in display order (other numeric
+# fields are appended alphabetically)
+PREFERRED = [
+    "loss",
+    "grad_norm",
+    "tokens_per_sec",
+    "steps_per_sec",
+    "step_ms_p50",
+    "step_ms_p95",
+    "mfu",
+]
+
+# comms-accounting fields echoed as a static block (they do not vary per
+# step — one line each beats 5 columns of constants)
+ACCOUNTING = [
+    "ring_size",
+    "ulysses_size",
+    "ring_hops",
+    "pure_ring_hops",
+    "ring_hops_per_step",
+    "hop_bytes",
+    "ring_bytes_per_step",
+    "ring_bytes_per_step_bwd",
+    "a2a_bytes_per_step",
+    "hop_overlap_fraction",
+]
+
+# stage buckets for the xprof table, keyed on the stable scope/kernel
+# names threaded through parallel/ and ops/ (docs/observability.md)
+STAGES = [
+    ("ring/hop", "ring hop compute"),
+    ("ring/rotate", "ring kv rotation"),
+    ("ring/bwd", "ring backward"),
+    ("ring/catchup", "ring dkv catch-up"),
+    ("ulysses/a2a", "ulysses all-to-all"),
+    ("ulysses/flash", "ulysses local flash"),
+    ("hybrid/a2a", "hybrid all-to-all"),
+    ("hybrid/inner", "hybrid inner ring"),
+    ("zigzag/", "zigzag"),
+    ("tree_decode/gather", "tree-decode merge"),
+    ("tree_decode/", "tree-decode local"),
+    ("flash_bwd", "flash backward kernel"),  # pallas kernel name
+    ("flash/bwd", "flash backward"),  # XLA-path named_scope
+    ("flash_decode", "flash decode kernel"),
+    ("flash", "flash forward kernel"),
+]
+
+
+def _read_rows(path: str) -> list[dict]:
+    """The library's own reader (``telemetry.read_metrics`` — the one the
+    killed-writer tests pin), loaded by file path so this tool never
+    imports the package (whose ``__init__`` pulls in jax/flax)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_report_telemetry",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "ring_attention_tpu", "utils", "telemetry.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod.read_metrics(path)
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    pos = q * (len(values) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(values) - 1)
+    frac = pos - lo
+    return values[lo] * (1 - frac) + values[hi] * frac
+
+
+def _fmt(x: float) -> str:
+    if x == 0:
+        return "0"
+    if abs(x) >= 1e5 or abs(x) < 1e-3:
+        return f"{x:.3e}"
+    return f"{x:,.4f}".rstrip("0").rstrip(".")
+
+
+def metrics_report(rows: list[dict], out: list[str]) -> None:
+    metric_rows = [r for r in rows if "event" not in r]
+    events = [r for r in rows if "event" in r]
+    steps = [r.get("step") for r in metric_rows if "step" in r]
+    schemas = sorted({r.get("schema") for r in rows if "schema" in r})
+    out.append(
+        f"rows: {len(metric_rows)} metric + {len(events)} event | "
+        f"steps {min(steps) if steps else '-'}..{max(steps) if steps else '-'}"
+        f" | schema {','.join(str(s) for s in schemas) or '-'}"
+    )
+    for ev in events:
+        kind = ev.get("event")
+        detail = ev.get("component") or ev.get("reason") or ""
+        out.append(f"  event: {kind} {detail}".rstrip())
+    degraded = sum(int(r.get("degraded", 0)) for r in rows)
+    if degraded:
+        out.append(f"  DEGRADED run: {degraded} kernel-fallback event(s) — "
+                   f"see ring_attention_tpu.utils.resilience.degradation")
+    if not metric_rows:
+        return
+
+    numeric: dict[str, list[float]] = defaultdict(list)
+    for r in metric_rows:
+        for key, val in r.items():
+            if key in ("schema", "step", "time") or isinstance(val, bool):
+                continue
+            if isinstance(val, (int, float)):
+                numeric[key].append(float(val))
+
+    acct = [k for k in ACCOUNTING if k in numeric]
+    if acct:
+        out.append("")
+        out.append("comms accounting (analytic, per device)")
+        for key in acct:
+            out.append(f"  {key:24s} {_fmt(numeric[key][-1])}")
+
+    cols = [k for k in PREFERRED if k in numeric]
+    cols += sorted(k for k in numeric if k not in cols and k not in acct)
+    out.append("")
+    out.append(f"  {'metric':20s} {'last':>12s} {'mean':>12s} "
+               f"{'p50':>12s} {'p95':>12s}")
+    for key in cols:
+        vals = numeric[key]
+        out.append(
+            f"  {key:20s} {_fmt(vals[-1]):>12s} "
+            f"{_fmt(sum(vals) / len(vals)):>12s} "
+            f"{_fmt(_percentile(vals, 0.5)):>12s} "
+            f"{_fmt(_percentile(vals, 0.95)):>12s}"
+        )
+
+
+def _stage_of(op_name: str) -> str | None:
+    n = op_name.lower()
+    for needle, label in STAGES:
+        if needle in n:
+            return label
+    return None
+
+
+def xprof_report(trace_dir: str, out: list[str]) -> None:
+    """Per-stage device time from an xplane capture, keyed on the stable
+    scope names.  Best-effort: a missing proto parser or an empty capture
+    degrades to a note, never an error (the metrics table above is the
+    primary product)."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as e:  # ImportError or any TF-init failure
+        out.append(f"[xprof] parser unavailable ({type(e).__name__}); "
+                   f"traces under {trace_dir} — parse offline")
+        return
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        out.append(f"[xprof] no .xplane.pb under {trace_dir}")
+        return
+    space = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as f:
+        space.ParseFromString(f.read())
+    planes = [
+        p for p in space.planes if "TPU" in p.name or "/device:" in p.name
+    ] or list(space.planes)
+    per_stage: dict[str, float] = defaultdict(float)
+    total = 0.0
+    for plane in planes:
+        op_lines = [l for l in plane.lines if "XLA Ops" in l.name]
+        for line in op_lines or plane.lines:
+            for ev in line.events:
+                meta = plane.event_metadata.get(ev.metadata_id)
+                name = meta.name if meta else ""
+                # scope names ride the op's display name or its metadata
+                label = _stage_of(name) or _stage_of(
+                    getattr(meta, "display_name", "") if meta else ""
+                )
+                ms = ev.duration_ps / 1e9
+                total += ms
+                per_stage[label or "other"] += ms
+    if not total:
+        out.append(f"[xprof] no events parsed under {trace_dir}")
+        return
+    out.append("")
+    out.append(f"per-stage device time ({trace_dir})")
+    out.append(f"  {'stage':28s} {'ms':>10s} {'share':>7s}")
+    for label, ms in sorted(per_stage.items(), key=lambda kv: -kv[1]):
+        out.append(f"  {label:28s} {ms:10.3f} {100 * ms / total:6.1f}%")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render telemetry JSONL (+ optional xprof capture) "
+                    "into per-metric / per-stage tables"
+    )
+    ap.add_argument("metrics",
+                    help="metrics directory (holding metrics.jsonl) or a "
+                         "JSONL file written by MetricsLogger")
+    ap.add_argument("--xprof", default=None,
+                    help="xprof capture dir (tools/xprof_capture.py / "
+                         "utils.profiling.trace): adds a per-stage device-"
+                         "time table keyed on the stable trace names")
+    ap.add_argument("--last", type=int, default=None,
+                    help="summarize only the last N metric rows")
+    args = ap.parse_args(argv)
+
+    rows = _read_rows(args.metrics)
+    if args.last is not None:
+        events = [r for r in rows if "event" in r]
+        metric = [r for r in rows if "event" not in r][-args.last:]
+        rows = events + metric
+    out: list[str] = [f"trace report: {args.metrics}"]
+    metrics_report(rows, out)
+    if args.xprof:
+        xprof_report(args.xprof, out)
+    print("\n".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
