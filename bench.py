@@ -129,6 +129,30 @@ def _degradation_fields():
         return {}
 
 
+def _fingerprint_worker() -> None:
+    """Collective fingerprint of the hot entry points, from the contract
+    checker (``analysis/contracts.py``) on simulated CPU devices.
+
+    Per-strategy forward collective counts (ppermute / all_to_all /
+    all_gather) land in the bench JSON so the perf trajectory catches a
+    comms regression — an extra hop, an accidental O(seq) gather — even
+    when tokens/sec moves for unrelated reasons.  Needs no TPU: the
+    compiled collective sequence is backend-independent at this level, so
+    the fingerprint is emitted even on rounds where the TPU tunnel is
+    wedged.  Env must be set before the first jax import, which is why
+    this worker runs in its own subprocess.
+    """
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from ring_attention_tpu.analysis.contracts import collective_fingerprint
+
+    print(json.dumps(collective_fingerprint()))
+
+
 def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     """Runs one timed measurement and prints its own JSON line.
 
@@ -919,6 +943,17 @@ def main() -> None:
             return {"ok": False, "error": str(e.last)}
         return {"ok": True}
 
+    # phase 0 — collective fingerprint (CPU-only, before the TPU probe so
+    # it lands even on wedged rounds): per-strategy collective counts from
+    # the contract checker, the comms half of the perf trajectory
+    fp, fp_err = _run_attempt(
+        "cpu", 0, "fingerprint", float(os.environ.get("BENCH_FP_BUDGET_S", 420))
+    )
+    if fp is not None:
+        result["collective_fingerprint"] = fp
+    else:
+        result["collective_fingerprint"] = {"error": (fp_err or "failed")[-200:]}
+
     # probe once, reuse across phases AND back-to-back invocations: the
     # verdict is cached on disk with a TTL (see _cached_probe) so a wedged
     # tunnel costs its 180 s hang once per window, not once per round
@@ -1165,6 +1200,10 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         mode = sys.argv[4] if len(sys.argv) > 4 else "fwd"
         extra = json.loads(sys.argv[5]) if len(sys.argv) > 5 else {}
-        _worker(sys.argv[2], int(sys.argv[3]), mode, extra)
+        if mode == "fingerprint":
+            # env setup must precede the first jax import (see the worker)
+            _fingerprint_worker()
+        else:
+            _worker(sys.argv[2], int(sys.argv[3]), mode, extra)
     else:
         main()

@@ -1,0 +1,49 @@
+"""Static-analysis subsystem: machine-checked contracts for the package.
+
+Three passes, all CPU-runnable in tier-1 (see docs/static_analysis.md):
+
+  - :mod:`~ring_attention_tpu.analysis.contracts` — declarative
+    collective/HLO contracts per sequence-parallel strategy, verified
+    against optimized HLO and jaxpr structure;
+  - :mod:`~ring_attention_tpu.analysis.lint` — repo-native AST lint
+    (compat-shim bypasses, unnamed kernels, unscoped collectives, host
+    entropy in traced code, unvalidated entry points);
+  - :mod:`~ring_attention_tpu.analysis.recompile` — retrace sentinel
+    (each entry point compiles exactly once per shape) and the f32
+    accumulator-dtype audit.
+
+CLI: ``tools/check_contracts.py`` (full contract suite) and
+``python -m ring_attention_tpu.analysis`` (lint + dtype audit self-run).
+On a host without jax, run the lint as a plain script —
+``python ring_attention_tpu/analysis/lint.py`` — which skips this
+package ``__init__`` chain entirely.
+"""
+
+from .lint import Violation, lint_file, lint_package, lint_source
+from .recompile import (
+    CompileCounter,
+    RetraceError,
+    assert_compiles_once,
+    audit_accumulator_dtypes,
+)
+
+__all__ = [
+    "CompileCounter",
+    "RetraceError",
+    "Violation",
+    "assert_compiles_once",
+    "audit_accumulator_dtypes",
+    "lint_file",
+    "lint_package",
+    "lint_source",
+    # contracts is imported lazily (it pulls in jax + the parallel stack):
+    "contracts",
+]
+
+
+def __getattr__(name: str):
+    if name == "contracts":
+        import importlib
+
+        return importlib.import_module(".contracts", __name__)
+    raise AttributeError(name)

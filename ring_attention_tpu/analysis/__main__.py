@@ -1,0 +1,39 @@
+"""Self-run: ``python -m ring_attention_tpu.analysis``.
+
+Lints the whole package tree and, unless ``--no-audit``, runs the f32
+accumulator-dtype audit.  Exit status 0 = clean.  The ``-m`` form imports
+the package ``__init__`` chain (which needs jax); on a host without jax,
+run the lint as a plain script instead:
+``python ring_attention_tpu/analysis/lint.py``.  The full
+collective-contract suite needs virtual devices and lives in
+``tools/check_contracts.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .lint import lint_package
+from . import recompile
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ring_attention_tpu.analysis",
+        description="lint the package tree + audit kernel accumulator dtypes",
+    )
+    parser.add_argument("--no-audit", action="store_true",
+                        help="skip the (jax-importing) f32 accumulator audit")
+    args = parser.parse_args(argv)
+
+    failures = [str(v) for v in lint_package()]
+    if not args.no_audit:
+        failures.extend(recompile.audit_accumulator_dtypes())
+    for line in failures:
+        print(line)
+    print(f"{len(failures)} finding(s)" if failures else "clean")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,822 @@
+"""Declarative collective/HLO contracts for every sequence-parallel entry.
+
+Ring attention's value proposition IS a communication contract: exactly
+``ring - 1`` collective-permutes per forward (Liu et al.; Striped Attention
+changes only the permutation, not the count), ``2*ring - 1`` in backward
+(the kv counter-rotation — ``ring - 1`` after XLA drops the unused final
+rotate — plus the full ``ring``-hop dkv circulation back to its owner), and
+the hybrid factoring must cut the hop count by the Ulysses degree while
+adding exactly two all-to-alls per tensor leg.  Before this module those
+invariants lived as scattered one-off HLO pins; here they are ONE
+declarative table (:data:`CONTRACTS`), verified two ways for every strategy
+x mesh shape:
+
+  - **optimized HLO** (the hot path: unrolled Pallas hop loop, or the XLA
+    path for gather/all-to-all strategies): exact instruction counts per
+    collective kind, source/target-pair and replica-group *axis* checks
+    (a ring permute must keep every non-ring mesh coordinate fixed), and
+    the global rule that any collective kind the contract does not declare
+    must not appear at all — an accidental ``all-gather`` of O(seq)
+    activations fails loudly;
+  - **jaxpr structure** (the scanned XLA path): collective counts with
+    scan bodies multiplied by their trip count, plus the rule that no
+    collective may sit inside a ``lax.cond`` branch (a data-dependent
+    collective schedule deadlocks SPMD programs).
+
+Count expressions are strings evaluated over the mesh dims
+(``ring`` / ``ulysses`` / ``world`` / ``passes`` / ``data``) so the table
+reads as documentation (docs/static_analysis.md renders it directly).
+
+The contracts pin the *base* path: unsegmented, unmasked, unidirectional,
+full passes — the configuration every other variant adds collectives onto.
+All checks run on CPU (``--xla_force_host_platform_device_count``); the
+compiled collective sequence is backend-independent at this level.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# The declarative table
+# ---------------------------------------------------------------------------
+
+CONTRACTS: dict[str, dict[str, Any]] = {
+    "ring": {
+        "description": "KV rotation: one ppermute per hop, nothing else",
+        "impl": "pallas",
+        "mesh": "plain",
+        "axes": {"collective-permute": "seq"},
+        "hlo": {
+            "fwd": {"collective-permute": "ring - 1"},
+            "fwdbwd": {"collective-permute": "3 * ring - 2"},
+        },
+        "scan": {
+            "fwd": {"ppermute": "passes"},
+            "fwdbwd": {"ppermute": "3 * passes"},
+        },
+    },
+    "striped": {
+        "description": "balanced-causal ring: permutation changes, count "
+                       "does not (Striped Attention, arXiv 2311.09431)",
+        "impl": "pallas",
+        "mesh": "plain",
+        "striped": True,
+        "axes": {"collective-permute": "seq"},
+        "hlo": {
+            "fwd": {"collective-permute": "ring - 1"},
+            "fwdbwd": {"collective-permute": "3 * ring - 2"},
+        },
+        "scan": {
+            "fwd": {"ppermute": "passes"},
+            "fwdbwd": {"ppermute": "3 * passes"},
+        },
+    },
+    "zigzag": {
+        "description": "Llama-3 CP: gather K and V once; grads flow back "
+                       "through the gather transpose (reduce-scatter)",
+        "impl": "xla",
+        "mesh": "plain",
+        "axes": {"all-gather": "seq", "reduce-scatter": "seq"},
+        "hlo": {
+            "fwd": {"all-gather": "2"},
+            "fwdbwd": {"all-gather": "2", "reduce-scatter": "2"},
+        },
+    },
+    "ulysses": {
+        "description": "head-parallel: two all-to-alls per tensor leg "
+                       "(q/k/v in, out back; bwd transposes combine to 3)",
+        "impl": "xla",
+        "mesh": "plain",
+        "axes": {"all-to-all": "seq"},
+        "hlo": {
+            "fwd": {"all-to-all": "4"},
+            "fwdbwd": {"all-to-all": "7"},
+        },
+    },
+    "ulysses_gqa": {
+        "description": "small-hk GQA: real kv heads ship ONCE (all-gather) "
+                       "and expand locally — never world/gcd repeated "
+                       "all-to-all copies",
+        "impl": "xla",
+        "mesh": "plain",
+        "kv_heads": 2,
+        "directions": ("fwd",),
+        "axes": {"all-to-all": "seq", "all-gather": "seq"},
+        "hlo": {
+            "fwd": {"all-to-all": "2", "all-gather": "2"},
+        },
+    },
+    "hybrid": {
+        "description": "Ulysses x Ring factoring: all-to-alls on the inner "
+                       "axis only, ppermutes on the outer axis only, "
+                       "ulysses-x fewer hops than a pure ring at equal world",
+        "impl": "pallas",
+        "mesh": "factored",
+        "axes": {
+            "collective-permute": "ring",
+            "all-to-all": "ulysses",
+            "all-gather": "ulysses",
+        },
+        "hlo": {
+            "fwd": {"all-to-all": "4", "collective-permute": "ring - 1"},
+            "fwdbwd": {"all-to-all": "7", "collective-permute": "3 * ring - 2"},
+        },
+        "scan": {
+            "fwd": {"ppermute": "passes", "all_to_all": "4"},
+            "fwdbwd": {"ppermute": "3 * passes", "all_to_all": "8"},
+        },
+    },
+    "tree_decode": {
+        "description": "tree-attention decode merge: pmax + two psums, "
+                       "nothing touches the O(seq) cache shards",
+        "impl": "xla",
+        "mesh": "plain",
+        "directions": ("fwd",),
+        "axes": {"all-reduce": "seq"},
+        "hlo": {
+            "fwd": {"all-reduce": "3"},
+        },
+        "scan": {
+            "fwd": {"pmax": "1", "psum": "2"},
+        },
+    },
+}
+
+# Collective kinds tracked in optimized HLO.  Any kind present in a
+# program but absent from its contract's expectation dict is a violation
+# (the "no undeclared collective in the hot path" rule).
+HLO_COLLECTIVE_KINDS = (
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-broadcast",
+)
+
+# jaxpr-level collective primitive names (the traced contract).
+JAXPR_COLLECTIVE_PRIMS = {
+    "ppermute",
+    "all_to_all",
+    "all_gather",
+    "all_gather_invariant",
+    "psum",
+    "psum_invariant",
+    "pmax",
+    "pmin",
+    "reduce_scatter",
+    "psum_scatter",
+}
+
+_HLO_COLLECTIVE_RE = re.compile(
+    r"%?(" + "|".join(HLO_COLLECTIVE_KINDS) + r")(?:-start)?[.\d]* = "
+)
+_PPERMUTE_PAIRS_RE = re.compile(
+    r"collective-permute[^\n]*source_target_pairs=\{([0-9,{} ]*)\}"
+)
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[0-9, ]+\}(?:,\{[0-9, ]+\})*)\}")
+# iota (v2) form some XLA builds print instead of brace lists:
+#   replica_groups=[2,4]<=[8]  or  [4,2]<=[2,4]T(1,0)
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+
+
+def _parse_replica_groups(line: str) -> list[list[int]] | None | str:
+    """Replica groups of one HLO instruction line: a list of groups, None
+    when the instruction carries no ``replica_groups=`` attribute at all
+    (scalar/degenerate form), or an error string for a format this parser
+    does not recognize — callers must surface that loudly, never skip it
+    (a silently unparsed group would turn the axis rule into a no-op)."""
+    gm = _GROUPS_RE.search(line)
+    if gm:
+        return [
+            [int(x) for x in g.split(",")]
+            for g in re.findall(r"\{([0-9, ]+)\}", gm.group(1))
+        ]
+    im = _GROUPS_IOTA_RE.search(line)
+    if im:
+        ng, gs = int(im.group(1)), int(im.group(2))
+        dims = [int(x) for x in im.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if im.group(4):
+            ids = ids.transpose([int(x) for x in im.group(4).split(",")])
+        return ids.reshape(ng, gs).tolist()
+    if "replica_groups=" in line:
+        return line.split("replica_groups=", 1)[1][:40]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# HLO-side helpers (shared with the test-suite pins)
+# ---------------------------------------------------------------------------
+
+
+def hlo_collective_sequence(txt: str) -> list[str]:
+    """Collective kinds in program order — the telemetry pin's signature:
+    an instrumented program must issue the same sequence as its base."""
+    return [m.group(1) for m in _HLO_COLLECTIVE_RE.finditer(txt)]
+
+
+def hlo_collective_counts(txt: str) -> dict[str, int]:
+    """Collective instruction counts per kind in optimized HLO text."""
+    return dict(Counter(hlo_collective_sequence(txt)))
+
+
+def hlo_ppermute_pairs(txt: str) -> list[list[tuple[int, int]]]:
+    """Per-instruction ``source_target_pairs`` of every collective-permute."""
+    return [
+        [(int(a), int(b)) for a, b in re.findall(r"\{(\d+),(\d+)\}", m.group(1))]
+        for m in _PPERMUTE_PAIRS_RE.finditer(txt)
+    ]
+
+
+def _device_coords(device_id: int, mesh_shape: tuple[int, ...]) -> tuple[int, ...]:
+    return tuple(int(c) for c in np.unravel_index(device_id, mesh_shape))
+
+
+def check_pairs_axis(
+    pairs: list[list[tuple[int, int]]],
+    mesh_shape: tuple[int, ...],
+    axis_index: int,
+    axis_name: str,
+) -> list[str]:
+    """Every source->target pair must change ONLY the given mesh axis."""
+    out = []
+    for inst, ps in enumerate(pairs):
+        if not ps:
+            out.append(
+                f"collective-permute #{inst}: empty source_target_pairs "
+                f"[rule: {axis_name}-axis-only]"
+            )
+        for s, t in ps:
+            cs, ct = _device_coords(s, mesh_shape), _device_coords(t, mesh_shape)
+            fixed_ok = all(
+                cs[i] == ct[i] for i in range(len(mesh_shape)) if i != axis_index
+            )
+            if not fixed_ok or s == t:
+                out.append(
+                    f"collective-permute #{inst}: pair {s}->{t} leaves the "
+                    f"{axis_name} axis (coords {cs}->{ct}) "
+                    f"[rule: {axis_name}-axis-only]"
+                )
+    return out
+
+
+def check_groups_axis(
+    txt: str,
+    kind: str,
+    mesh_shape: tuple[int, ...],
+    axis_index: int,
+    axis_name: str,
+) -> list[str]:
+    """Replica groups of ``kind`` instructions must each span exactly the
+    given mesh axis (all other coordinates fixed within a group)."""
+    out = []
+    inst_re = re.compile(r"%?" + re.escape(kind) + r"(?:-start)?[.\d]* = [^\n]*")
+    for inst, line in enumerate(inst_re.findall(txt)):
+        groups = _parse_replica_groups(line)
+        if groups is None:
+            continue  # scalar/degenerate form without explicit groups
+        if isinstance(groups, str):
+            out.append(
+                f"{kind} #{inst}: unrecognized replica_groups format "
+                f"{groups!r} — cannot verify the {axis_name} axis rule "
+                f"[rule: {axis_name}-axis-only]"
+            )
+            continue
+        for g in groups:
+            coords = [_device_coords(d, mesh_shape) for d in g]
+            for i in range(len(mesh_shape)):
+                if i == axis_index:
+                    continue
+                if len({c[i] for c in coords}) != 1:
+                    out.append(
+                        f"{kind} #{inst}: group {g} spans mesh axis {i}, "
+                        f"not only {axis_name} [rule: {axis_name}-axis-only]"
+                    )
+            if len(g) != mesh_shape[axis_index]:
+                out.append(
+                    f"{kind} #{inst}: group {g} does not cover the full "
+                    f"{axis_name} axis (size {mesh_shape[axis_index]}) "
+                    f"[rule: {axis_name}-axis-only]"
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-side helpers
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JaxprCollectives:
+    """Scan-aware collective counts from a traced program."""
+
+    counts: dict[str, int] = field(default_factory=dict)
+    in_cond: list[str] = field(default_factory=list)  # prims under lax.cond
+    in_while: list[str] = field(default_factory=list)  # prims under lax.while
+
+    @property
+    def dynamic(self) -> bool:
+        """A while-loop body issues collectives: the trip count is unknown
+        statically, so no count expression can verify the program."""
+        return bool(self.in_while)
+
+
+def _sub_jaxprs(value):
+    import jax
+
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, jax.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax.core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            stack.extend(v)
+
+
+def jaxpr_collectives(closed_jaxpr) -> JaxprCollectives:
+    """Walk a (closed) jaxpr counting collective primitives, multiplying
+    counts inside ``lax.scan`` bodies by the trip count and recording any
+    collective that sits inside a ``lax.cond`` branch (a divergent
+    collective schedule — the SPMD deadlock hazard this codebase keeps
+    its rotations outside conds to avoid) or a ``lax.while_loop`` body
+    (trip count unknown statically — no count expression can verify the
+    program, so the checkers fail it rather than undercount)."""
+    res = JaxprCollectives(counts=Counter())
+
+    def walk(jaxpr, mult: int, in_cond: bool, in_while: bool) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in JAXPR_COLLECTIVE_PRIMS:
+                res.counts[name] += mult
+                if in_cond:
+                    res.in_cond.append(name)
+                if in_while:
+                    res.in_while.append(name)
+            if name == "scan":
+                walk(eqn.params["jaxpr"].jaxpr,
+                     mult * int(eqn.params["length"]), in_cond, in_while)
+            elif name == "cond":
+                for br in eqn.params["branches"]:
+                    walk(br.jaxpr, mult, True, in_while)
+            elif name == "while":
+                for key in ("body_jaxpr", "cond_jaxpr"):
+                    walk(eqn.params[key].jaxpr, mult, in_cond, True)
+            else:
+                for v in eqn.params.values():
+                    for sub in _sub_jaxprs(v):
+                        walk(sub, mult, in_cond, in_while)
+
+    walk(closed_jaxpr.jaxpr, 1, False, False)
+    res.counts = dict(res.counts)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Contract evaluation
+# ---------------------------------------------------------------------------
+
+
+def expected_counts(strategy: str, direction: str, dims: dict[str, int],
+                    table: str = "hlo") -> dict[str, int]:
+    """Evaluate the contract table's count expressions for one strategy."""
+    contract = CONTRACTS[strategy]
+    exprs = contract.get(table, {}).get(direction)
+    if exprs is None:
+        raise KeyError(f"{strategy} declares no {table!r} contract for "
+                       f"{direction!r}")
+    ns = dict(dims)
+    return {
+        kind: int(eval(expr, {"__builtins__": {}}, ns))  # noqa: S307 - table-only
+        for kind, expr in exprs.items()
+    }
+
+
+@dataclass
+class ContractReport:
+    strategy: str
+    direction: str
+    impl: str
+    mesh_shape: tuple[int, ...]
+    dims: dict[str, int]
+    counts: dict[str, int] = field(default_factory=dict)
+    expected: dict[str, int] = field(default_factory=dict)
+    jaxpr_counts: dict[str, int] = field(default_factory=dict)
+    violations: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return {
+            "strategy": self.strategy,
+            "direction": self.direction,
+            "impl": self.impl,
+            "mesh_shape": list(self.mesh_shape),
+            "dims": self.dims,
+            "counts": self.counts,
+            "expected": self.expected,
+            "jaxpr_counts": self.jaxpr_counts,
+            "ok": self.ok,
+            "violations": self.violations,
+        }
+
+
+def _mesh_dims(mesh) -> dict[str, int]:
+    from ..parallel.mesh import RING_AXIS, SEQ_AXIS, ULYSSES_AXIS, seq_world
+
+    shape = dict(mesh.shape)
+    ring = shape.get(RING_AXIS) or shape.get(SEQ_AXIS) or 1
+    return {
+        "data": shape.get("data", 1),
+        "ring": ring,
+        "ulysses": shape.get(ULYSSES_AXIS, 1),
+        "world": seq_world(mesh),
+        "passes": ring,
+    }
+
+
+def default_mesh(strategy: str):
+    """The canonical CPU mesh for a strategy: all devices on the sequence
+    axis (factored with ulysses=2 for hybrid)."""
+    import jax
+
+    from ..parallel.mesh import create_mesh
+
+    n = len(jax.devices())
+    if CONTRACTS[strategy].get("mesh") == "factored":
+        return create_mesh(ulysses_size=2, ring_size=n // 2)
+    return create_mesh(ring_size=n)
+
+
+def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
+                seq: int = 64, dim_head: int = 8, impl: str | None = None):
+    """(fn, args, dims): the strategy's functional core wrapped in
+    ``compat.shard_map`` over ``mesh``, ready to lower.  ``fn`` takes
+    ``(q, k, v)`` global arrays; tiny shapes — these programs exist to be
+    compiled and inspected, not run."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.hybrid import hybrid_attention
+    from ..parallel.mesh import (
+        DATA_AXIS,
+        RING_AXIS,
+        SEQ_AXIS,
+        ULYSSES_AXIS,
+        is_factored,
+        seq_partition,
+    )
+    from ..parallel.ring import ring_flash_attention
+    from ..parallel.tree_decode import tree_attn_decode
+    from ..parallel.ulysses import ulysses_attention
+    from ..parallel.zigzag import zigzag_attention
+    from ..utils import compat
+
+    contract = CONTRACTS[strategy]
+    impl = impl or contract["impl"]
+    kv_heads = contract.get("kv_heads", heads)
+    striped = contract.get("striped", False)
+    dims = _mesh_dims(mesh)
+    if contract.get("mesh") == "factored" and not is_factored(mesh):
+        raise ValueError(f"{strategy} needs a factored (data, ring, ulysses) "
+                         "mesh — create_mesh(ulysses_size=...)")
+    if contract.get("mesh") == "plain" and is_factored(mesh):
+        raise ValueError(f"{strategy} runs on a plain (data, seq) mesh")
+
+    rng = np.random.default_rng(0)
+    b = b * dims["data"]  # the batch must tile the data axis
+
+    def mk(h, n=seq):
+        return jnp.asarray(rng.standard_normal((b, h, n, dim_head)),
+                           jnp.float32)
+
+    spec = P(DATA_AXIS, None, seq_partition(mesh), None)
+    rep = P(DATA_AXIS, None, None, None)
+    bucket = max(seq // dims["world"] // 2, 4)
+
+    if strategy in ("ring", "striped"):
+        def core(q, k, v):
+            return ring_flash_attention(
+                q, k, v, None, SEQ_AXIS, causal=True, striped=striped,
+                bucket_size=bucket, impl=impl,
+            )
+        in_specs = (spec, spec, spec)
+        out_specs = spec
+        args = (mk(heads), mk(kv_heads), mk(kv_heads))
+    elif strategy == "zigzag":
+        def core(q, k, v):
+            return zigzag_attention(
+                q, k, v, SEQ_AXIS, causal=True, bucket_size=bucket, impl=impl,
+            )
+        in_specs = (spec, spec, spec)
+        out_specs = spec
+        args = (mk(heads), mk(kv_heads), mk(kv_heads))
+    elif strategy in ("ulysses", "ulysses_gqa"):
+        def core(q, k, v):
+            return ulysses_attention(
+                q, k, v, SEQ_AXIS, causal=True, bucket_size=bucket, impl=impl,
+            )
+        in_specs = (spec, spec, spec)
+        out_specs = spec
+        args = (mk(heads), mk(kv_heads), mk(kv_heads))
+    elif strategy == "hybrid":
+        def core(q, k, v):
+            return hybrid_attention(
+                q, k, v, None, ULYSSES_AXIS, RING_AXIS, causal=True,
+                bucket_size=bucket, impl=impl,
+            )
+        in_specs = (spec, spec, spec)
+        out_specs = spec
+        args = (mk(heads), mk(kv_heads), mk(kv_heads))
+    elif strategy == "tree_decode":
+        def core(q, k, v):
+            return tree_attn_decode(
+                q, k, v, axis_name=SEQ_AXIS, bucket_size=bucket, impl=impl,
+            )
+        in_specs = (rep, spec, spec)
+        out_specs = rep
+        args = (mk(heads, 1), mk(kv_heads), mk(kv_heads))
+    else:
+        raise KeyError(f"unknown strategy {strategy!r}; "
+                       f"known: {sorted(CONTRACTS)}")
+
+    fn = compat.shard_map(
+        core, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=(impl != "pallas"),
+    )
+    return fn, args, dims
+
+
+def _direction_fn(fn, direction: str):
+    import jax
+
+    if direction == "fwd":
+        return fn
+    if direction == "fwdbwd":
+        def grads(q, k, v):
+            return jax.grad(
+                lambda q, k, v: fn(q, k, v).sum(), argnums=(0, 1, 2)
+            )(q, k, v)
+        return grads
+    raise ValueError(f"unknown direction {direction!r}")
+
+
+def verify_hlo(strategy: str, direction: str, txt: str,
+               dims: dict[str, int], mesh_shape: tuple[int, ...],
+               axis_names: list[str]) -> list[str]:
+    """Check one compiled program's optimized-HLO text against a
+    strategy's contract: exact counts for every declared collective kind,
+    zero for every undeclared kind, axis discipline for permute pairs and
+    replica groups.  Returns one-line violations (empty = contract holds).
+
+    This is the shared core behind :func:`check_strategy`, the test-suite
+    pins, and negative-case toys — anything that can produce HLO text can
+    be held to a contract.
+    """
+    contract = CONTRACTS[strategy]
+    counts = hlo_collective_counts(txt)
+    expected = expected_counts(strategy, direction, dims)
+    violations: list[str] = []
+
+    for kind in HLO_COLLECTIVE_KINDS:
+        got = counts.get(kind, 0)
+        want = expected.get(kind, 0)
+        if got != want:
+            expr = contract["hlo"][direction].get(kind, "0 (undeclared)")
+            violations.append(
+                f"{strategy}/{direction}: {kind} x{got}, contract says "
+                f"{want} ({expr!r} at {dims_str(dims)}) "
+                f"[rule: collective-contract]"
+            )
+
+    for kind, axis in contract.get("axes", {}).items():
+        if axis not in axis_names:
+            continue
+        axis_index = axis_names.index(axis)
+        if kind == "collective-permute":
+            violations.extend(check_pairs_axis(
+                hlo_ppermute_pairs(txt), mesh_shape, axis_index, axis,
+            ))
+        else:
+            violations.extend(check_groups_axis(
+                txt, kind, mesh_shape, axis_index, axis,
+            ))
+    return violations
+
+
+def check_strategy(strategy: str, mesh=None, *, directions=None,
+                   **shape_kw) -> list[ContractReport]:
+    """Verify one strategy's collective contract on a mesh.
+
+    For each direction the entry point is compiled and its optimized HLO
+    checked against the declarative table: exact counts per declared
+    collective kind, zero for every undeclared kind, axis discipline for
+    permute pairs and replica groups — plus the jaxpr-structure rules
+    (scan-aware counts where declared; never a collective inside a
+    ``lax.cond`` branch).  Returns one :class:`ContractReport` per
+    direction; a report with a non-empty ``violations`` list failed.
+    """
+    import jax
+
+    from ..utils import compat
+
+    contract = CONTRACTS[strategy]
+    if mesh is None:
+        mesh = default_mesh(strategy)
+    if directions is None:
+        directions = contract.get("directions", ("fwd", "fwdbwd"))
+    mesh_shape = tuple(mesh.shape.values())
+    axis_names = list(mesh.shape.keys())
+
+    fn, args, dims = build_entry(strategy, mesh, **shape_kw)
+    reports = []
+    for direction in directions:
+        dfn = _direction_fn(fn, direction)
+        report = ContractReport(
+            strategy=strategy, direction=direction, impl=contract["impl"],
+            mesh_shape=mesh_shape, dims=dims,
+        )
+        txt = compat.jit(dfn).lower(*args).compile().as_text()
+        report.counts = hlo_collective_counts(txt)
+        report.expected = expected_counts(strategy, direction, dims)
+        report.violations.extend(verify_hlo(
+            strategy, direction, txt, dims, mesh_shape, axis_names,
+        ))
+
+        # traced structure: scan-aware counts + the no-collective-in-cond rule
+        jc = jaxpr_collectives(jax.make_jaxpr(dfn)(*args))
+        report.jaxpr_counts = jc.counts
+        if jc.in_cond:
+            report.violations.append(
+                f"{strategy}/{direction}: collective(s) {sorted(set(jc.in_cond))} "
+                f"inside a lax.cond branch — data-dependent collective "
+                f"schedules deadlock SPMD programs [rule: no-cond-collective]"
+            )
+        if jc.dynamic:
+            report.violations.append(
+                f"{strategy}/{direction}: collective(s) "
+                f"{sorted(set(jc.in_while))} inside a lax.while_loop body — "
+                f"trip count unknown statically, collective counts "
+                f"unverifiable [rule: no-while-collective]"
+            )
+        reports.append(report)
+    return reports
+
+
+def check_scan_contract(strategy: str, mesh=None, *, directions=None,
+                        **shape_kw) -> list[ContractReport]:
+    """The traced (``impl="xla"``, scanned-hop) side of a strategy's
+    contract: jaxpr collective counts with scan multipliers."""
+    import jax
+
+    contract = dict(CONTRACTS[strategy])
+    if "scan" not in contract:
+        raise KeyError(f"{strategy} declares no scan contract")
+    if mesh is None:
+        mesh = default_mesh(strategy)
+    if directions is None:
+        directions = tuple(contract["scan"])
+
+    # rebuild the entry on the scanned XLA path
+    fn, args, dims = build_entry(strategy, mesh, impl="xla", **shape_kw)
+
+    reports = []
+    for direction in directions:
+        dfn = _direction_fn(fn, direction)
+        report = ContractReport(
+            strategy=strategy, direction=direction, impl="xla",
+            mesh_shape=tuple(mesh.shape.values()), dims=dims,
+        )
+        jc = jaxpr_collectives(jax.make_jaxpr(dfn)(*args))
+        report.jaxpr_counts = jc.counts
+        report.expected = expected_counts(strategy, direction, dims,
+                                          table="scan")
+        for prim, want in report.expected.items():
+            got = jc.counts.get(prim, 0)
+            if got != want:
+                expr = CONTRACTS[strategy]["scan"][direction][prim]
+                report.violations.append(
+                    f"{strategy}/{direction} (traced): {prim} x{got}, "
+                    f"contract says {want} ({expr!r} at {dims_str(dims)}) "
+                    f"[rule: collective-contract]"
+                )
+        if jc.in_cond:
+            report.violations.append(
+                f"{strategy}/{direction} (traced): collective(s) "
+                f"{sorted(set(jc.in_cond))} inside a lax.cond branch "
+                f"[rule: no-cond-collective]"
+            )
+        if jc.dynamic:
+            report.violations.append(
+                f"{strategy}/{direction} (traced): collective(s) "
+                f"{sorted(set(jc.in_while))} inside a lax.while_loop body — "
+                f"trip count unknown statically [rule: no-while-collective]"
+            )
+        reports.append(report)
+    return reports
+
+
+def check_hybrid_hop_reduction(world: int | None = None, ulysses: int = 2,
+                               **shape_kw) -> ContractReport:
+    """The tentpole relation, proven from two compiled programs: at equal
+    sequence-parallel world, the hybrid factoring's ring hop count is
+    exactly ``ulysses``-x smaller (``world/ulysses - 1`` vs ``world - 1``)."""
+    import jax
+
+    from ..parallel.mesh import create_mesh
+    from ..utils import compat
+
+    if world is None:
+        world = len(jax.devices())
+    hmesh = create_mesh(ulysses_size=ulysses, ring_size=world // ulysses)
+    rmesh = create_mesh(ring_size=world)
+
+    hfn, hargs, hdims = build_entry("hybrid", hmesh, **shape_kw)
+    rfn, rargs, rdims = build_entry("ring", rmesh, **shape_kw)
+    hops_h = hlo_collective_counts(
+        compat.jit(hfn).lower(*hargs).compile().as_text()
+    ).get("collective-permute", 0)
+    hops_r = hlo_collective_counts(
+        compat.jit(rfn).lower(*rargs).compile().as_text()
+    ).get("collective-permute", 0)
+
+    report = ContractReport(
+        strategy="hybrid_vs_ring", direction="fwd", impl="pallas",
+        mesh_shape=tuple(hmesh.shape.values()),
+        dims={**hdims, "pure_ring_world": world},
+        counts={"hybrid_hops": hops_h, "pure_ring_hops": hops_r},
+        expected={"hybrid_hops": world // ulysses - 1,
+                  "pure_ring_hops": world - 1},
+    )
+    if hops_r != world - 1:
+        report.violations.append(
+            f"pure ring at world {world}: {hops_r} hops, contract says "
+            f"{world - 1} [rule: hop-reduction]"
+        )
+    if hops_h != world // ulysses - 1:
+        report.violations.append(
+            f"hybrid at world {world} (ulysses {ulysses}): {hops_h} hops, "
+            f"contract says {world // ulysses - 1} [rule: hop-reduction]"
+        )
+    if (hops_h + 1) * ulysses != hops_r + 1:
+        report.violations.append(
+            f"hybrid hop chain ({hops_h + 1} rotations incl. the elided "
+            f"last) is not ulysses-x ({ulysses}) shorter than the pure "
+            f"ring's ({hops_r + 1}) [rule: hop-reduction]"
+        )
+    return report
+
+
+def dims_str(dims: dict[str, int]) -> str:
+    return ", ".join(f"{k}={v}" for k, v in sorted(dims.items()))
+
+
+def run_contract_suite(strategies=None, *, scan: bool = True,
+                       **shape_kw) -> list[ContractReport]:
+    """Every strategy's contract on its canonical CPU mesh, plus the
+    hybrid-vs-ring hop-reduction relation.  The CLI and the bench
+    fingerprint both run exactly this."""
+    if strategies is None or strategies == "all":
+        strategies = list(CONTRACTS)
+    reports: list[ContractReport] = []
+    for strategy in strategies:
+        reports.extend(check_strategy(strategy, **shape_kw))
+        if scan and "scan" in CONTRACTS[strategy]:
+            reports.extend(check_scan_contract(strategy, **shape_kw))
+    if "hybrid" in strategies and "ring" in strategies:
+        reports.append(check_hybrid_hop_reduction(**shape_kw))
+    return reports
+
+
+def collective_fingerprint(strategies=("ring", "ulysses", "hybrid")) -> dict:
+    """Compact comms signature for the bench JSON: per-strategy forward
+    collective counts from compiled HLO, so a perf trajectory catches a
+    hop-count or accidental-gather regression even when tokens/sec moves
+    for other reasons."""
+    out: dict[str, Any] = {}
+    ok = True
+    for strategy in strategies:
+        reports = check_strategy(strategy, directions=("fwd",))
+        rep = reports[0]
+        out[strategy] = {
+            k.replace("collective-permute", "ppermute")
+             .replace("all-to-all", "all_to_all")
+             .replace("all-gather", "all_gather")
+             .replace("all-reduce", "all_reduce"): v
+            for k, v in sorted(rep.counts.items())
+        }
+        ok = ok and rep.ok
+    out["contract_ok"] = ok
+    return out

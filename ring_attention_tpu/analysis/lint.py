@@ -1,0 +1,310 @@
+"""Repo-native AST lint: the rules this codebase keeps re-learning by hand.
+
+Every rule here encodes a failure mode that actually bit (or nearly bit) a
+past PR, with the shim/convention that prevents it:
+
+  RA001  ``jax.experimental.shard_map`` / ``jax.shard_map`` used directly —
+         must go through ``utils/compat.shard_map``.  The jax-0.4.x
+         container has neither ``check_vma`` nor a replication checker that
+         understands ``checkpoint_name`` residuals; a direct call crashes
+         the whole suite there (see ``utils/compat.py``).
+  RA002  ``jax.jit`` used directly in library code — must go through
+         ``utils/compat.jit``, which degrades ``donate_argnums`` gracefully
+         on jax builds that reject it (and keeps the door open for
+         package-wide jit policy).
+  RA003  ``pl.pallas_call`` without ``name=`` — unnamed kernels show up in
+         XProf as ``custom-call`` soup; every launch must carry its stable
+         trace name (docs/observability.md).
+  RA004  collective (``ppermute`` / ``all_to_all`` / ``all_gather`` /
+         ``psum`` / ``pmax`` / ``pmin`` / ``psum_scatter``) issued outside a
+         ``jax.named_scope`` block — unattributable communication time in
+         traces.
+  RA005  host-side entropy (``time.time`` / ``random.*`` / ``np.random.*``)
+         in traced-code subpackages (``ops/``, ``parallel/``, ``models/``) —
+         a host clock or RNG read inside a traced function is baked in at
+         trace time and silently constant across steps (``jax.random`` is
+         fine: it is traced).
+  RA006  ``print`` in library code — library output goes through
+         ``warnings`` / telemetry, never stdout.
+  RA007  public attention entry point (module-level ``def f(q, k, v, ...)``)
+         that never calls ``utils/validate.check_attention_args`` — layout
+         bugs then surface as einsum errors deep in the kernels instead of
+         a one-line ValueError at the API boundary.
+
+Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
+(for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
+itself a violation.  See docs/static_analysis.md.
+
+Stdlib-only on purpose: on a box where jax itself cannot import, run this
+module as a plain script (``python ring_attention_tpu/analysis/lint.py``)
+— the ``-m`` form imports the package ``__init__`` chain, which needs jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+# Subpackages whose modules are traced code end-to-end (RA005 scope).
+TRACED_SUBPACKAGES = ("ops", "parallel", "models")
+
+# The shim module: the one place allowed to touch the raw APIs.
+SHIM_MODULE = "utils/compat.py"
+
+COLLECTIVE_CALLS = {
+    "ppermute",
+    "all_to_all",
+    "all_gather",
+    "all_gather_invariant",
+    "psum",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "pshuffle",
+}
+
+HOST_TIME_ATTRS = {"time", "time_ns", "perf_counter", "monotonic", "process_time"}
+
+_ALLOW_RE = re.compile(r"#\s*ra:\s*allow\(\s*(RA\d{3})\b([^)]*)\)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:  # the one-line diagnostic format
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute chain (``jax.experimental.shard_map``)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _allowed(source_lines: list[str], lineno: int, rule: str) -> tuple[bool, bool]:
+    """(allowed, bare) — whether the line carries an ``# ra: allow`` pragma
+    for ``rule``, and whether the pragma is missing its reason."""
+    if 1 <= lineno <= len(source_lines):
+        m = _ALLOW_RE.search(source_lines[lineno - 1])
+        if m and m.group(1) == rule:
+            return True, not m.group(2).strip()
+    return False, False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.violations: list[Violation] = []
+        self.scope_depth = 0  # nesting inside `with jax.named_scope(...)`
+        self.is_shim = rel.replace("\\", "/").endswith(SHIM_MODULE)
+        self.traced_pkg = any(
+            rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
+            or f"/{p}/" in rel.replace("\\", "/")
+            for p in TRACED_SUBPACKAGES
+        )
+
+    def flag(self, node: ast.AST, rule: str, message: str) -> None:
+        lineno = getattr(node, "lineno", 1)
+        allowed, bare = _allowed(self.lines, lineno, rule)
+        if allowed and not bare:
+            return
+        if allowed and bare:
+            message = f"bare '# ra: allow({rule})' — a reason is mandatory"
+        self.violations.append(Violation(self.rel, lineno, rule, message))
+
+    # -- RA001 / RA002: shim bypass -----------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.startswith("jax.experimental.shard_map") and not self.is_shim:
+                self.flag(node, "RA001",
+                          "import of jax.experimental.shard_map bypasses "
+                          "utils/compat.shard_map (breaks on jax 0.4.x)")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if not self.is_shim:
+            if mod.startswith("jax.experimental.shard_map") or (
+                mod == "jax.experimental"
+                and any(a.name == "shard_map" for a in node.names)
+            ):
+                self.flag(node, "RA001",
+                          "import of jax.experimental.shard_map bypasses "
+                          "utils/compat.shard_map (breaks on jax 0.4.x)")
+            if mod == "jax" and any(a.name == "jit" for a in node.names):
+                self.flag(node, "RA002",
+                          "'from jax import jit' bypasses utils/compat.jit")
+            if mod == "jax" and any(a.name == "shard_map" for a in node.names):
+                self.flag(node, "RA001",
+                          "'from jax import shard_map' bypasses "
+                          "utils/compat.shard_map")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if not self.is_shim:
+            chain = _attr_chain(node)
+            if chain in ("jax.shard_map", "jax.experimental.shard_map",
+                         "jax.experimental.shard_map.shard_map"):
+                self.flag(node, "RA001",
+                          f"{chain} bypasses utils/compat.shard_map "
+                          "(breaks on jax 0.4.x)")
+                return  # don't re-flag the chain's own sub-attributes
+            if chain == "jax.jit":
+                self.flag(node, "RA002",
+                          "jax.jit bypasses utils/compat.jit "
+                          "(donation degradation, package jit policy)")
+        self.generic_visit(node)
+
+    # -- RA003..RA007: calls ------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else ""
+        )
+
+        if name == "pallas_call":
+            if not any(kw.arg == "name" for kw in node.keywords):
+                self.flag(node, "RA003",
+                          "pl.pallas_call without name= — kernel is "
+                          "unattributable in XProf traces")
+
+        if name in COLLECTIVE_CALLS and self.scope_depth == 0:
+            self.flag(node, "RA004",
+                      f"collective lax.{name} outside jax.named_scope — "
+                      "communication time unattributable in traces")
+
+        if self.traced_pkg:
+            chain = _attr_chain(func) if isinstance(func, ast.Attribute) else ""
+            if chain.startswith(("time.",)) and name in HOST_TIME_ATTRS:
+                self.flag(node, "RA005",
+                          f"host clock {chain}() in traced code — constant "
+                          "after trace; pass times in as arguments")
+            elif chain.startswith(("random.", "np.random.", "numpy.random.")):
+                self.flag(node, "RA005",
+                          f"host RNG {chain}() in traced code — constant "
+                          "after trace; use jax.random with an explicit key")
+
+        if (name == "print" and isinstance(func, ast.Name)
+                and not self.rel.endswith("__main__.py")):  # __main__ IS a CLI
+            self.flag(node, "RA006",
+                      "print() in library code — use warnings or telemetry")
+
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        named = any(
+            isinstance(item.context_expr, ast.Call)
+            and (
+                (isinstance(item.context_expr.func, ast.Attribute)
+                 and item.context_expr.func.attr == "named_scope")
+                or (isinstance(item.context_expr.func, ast.Name)
+                    and item.context_expr.func.id == "named_scope")
+            )
+            for item in node.items
+        )
+        if named:
+            self.scope_depth += 1
+        self.generic_visit(node)
+        if named:
+            self.scope_depth -= 1
+
+    # -- RA007: entry points must validate ----------------------------
+    def _check_entry_point(self, node: ast.FunctionDef) -> None:
+        if node.name.startswith("_"):
+            return
+        first3 = [a.arg for a in node.args.args[:3]]
+        if first3 != ["q", "k", "v"]:
+            return
+        validates = any(
+            isinstance(n, ast.Call)
+            and (
+                (isinstance(n.func, ast.Name)
+                 and n.func.id == "check_attention_args")
+                or (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "check_attention_args")
+            )
+            for n in ast.walk(node)
+        )
+        if not validates:
+            self.flag(node, "RA007",
+                      f"public entry point {node.name}(q, k, v, ...) never "
+                      "calls utils/validate.check_attention_args — layout "
+                      "bugs will surface deep in the kernels instead")
+
+    def visit_Module(self, node: ast.Module) -> None:
+        for child in node.body:
+            if isinstance(child, ast.FunctionDef):
+                self._check_entry_point(child)
+        self.generic_visit(node)
+
+
+def lint_source(source: str, rel: str, path: str = "") -> list[Violation]:
+    """Lint one module's source text; returns violations (possibly empty)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:  # a file that cannot parse is its own finding
+        return [Violation(rel, e.lineno or 1, "RA000", f"syntax error: {e.msg}")]
+    linter = _Linter(path or rel, rel, source)
+    linter.visit(tree)
+    return sorted(linter.violations, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_file(path: str | Path, root: str | Path | None = None) -> list[Violation]:
+    path = Path(path)
+    rel = str(path.relative_to(root)) if root else str(path)
+    return lint_source(path.read_text(), rel, str(path))
+
+
+def lint_package(root: str | Path | None = None) -> list[Violation]:
+    """Lint every module under ``ring_attention_tpu/`` (the library scope:
+    tools/, examples/, bench.py and tests/ are host-side and exempt)."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    root = Path(root)
+    pkg = root / "ring_attention_tpu"
+    out: list[Violation] = []
+    for path in sorted(pkg.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        out.extend(lint_file(path, root))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="ring-attention-tpu repo-native lint (rules RA001-RA007)"
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files to lint (default: the whole package)")
+    args = parser.parse_args(argv)
+    if args.paths:
+        violations = []
+        for p in args.paths:
+            violations.extend(lint_file(p))
+    else:
+        violations = lint_package()
+    for v in violations:
+        print(str(v))  # ra: allow(RA006 the lint CLI's own report output)
+    if violations:
+        print(f"{len(violations)} violation(s)")  # ra: allow(RA006 CLI output)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,168 @@
+"""Retrace sentinel + accumulator-dtype audit.
+
+A steady-state train/decode loop must compile each entry point exactly
+once; a retrace-per-step (a Python scalar changing dtype, a fresh closure
+per step, an unhashable static arg) turns a multi-hour run into a
+compile benchmark and is invisible in small tests — each step still
+*works*.  The sentinel here counts real XLA compilations two ways:
+
+  - :func:`assert_compiles_once` — drives a jitted callable through a
+    multi-step loop with fresh same-shaped inputs and asserts its compile
+    cache holds exactly one entry afterwards;
+  - :class:`CompileCounter` — a context manager counting backend
+    compilations process-wide (via jax's compilation logging), for loops
+    that call through several entry points at once.
+
+The dtype audit (:func:`audit_accumulator_dtypes`) pins the numerics
+contract the flash kernels are built on: the online-softmax running state
+``(acc, m, l)`` accumulates in float32 regardless of the input dtype —
+bf16 inputs with bf16 accumulation drift visibly over 262k-token sweeps.
+Both the XLA carry and the Pallas partials are checked via ``eval_shape``
+(abstract: no kernel runs, works on any backend).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+
+# Loggers that announce an actual backend compilation (cache miss) in the
+# jax versions this repo supports; record format pinned by _COMPILE_RE.
+_COMPILE_LOGGERS = (
+    "jax._src.interpreters.pxla",
+    "jax._src.dispatch",
+)
+_COMPILE_RE = re.compile(r"^Compiling ([^\s]+) with global shapes")
+
+
+class RetraceError(AssertionError):
+    """An entry point compiled more than once across a steady-state loop."""
+
+
+@dataclass
+class CompileCounter:
+    """Counts XLA compilations (trace-cache misses) under the context.
+
+    >>> with CompileCounter() as counter:
+    ...     for step in range(3):
+    ...         train_step(params, batch)
+    >>> counter.total  # 1 for a healthy loop
+    """
+
+    names: list[str] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return len(self.names)
+
+    def __enter__(self) -> "CompileCounter":
+        self._handlers = []
+        for name in _COMPILE_LOGGERS:
+            logger = logging.getLogger(name)
+            handler = logging.Handler(level=logging.DEBUG)
+            handler.emit = self._emit  # type: ignore[method-assign]
+            self._old_levels = getattr(self, "_old_levels", {})
+            self._old_levels[name] = logger.level
+            if logger.level > logging.DEBUG or logger.level == logging.NOTSET:
+                logger.setLevel(logging.DEBUG)
+            logger.addHandler(handler)
+            self._handlers.append((logger, handler))
+        return self
+
+    def _emit(self, record: logging.LogRecord) -> None:
+        m = _COMPILE_RE.match(record.getMessage())
+        if m:
+            self.names.append(m.group(1))
+
+    def __exit__(self, *exc) -> None:
+        for logger, handler in self._handlers:
+            logger.removeHandler(handler)
+            logger.setLevel(self._old_levels[logger.name])
+
+
+def _cache_size(jitted) -> int | None:
+    """Trace-cache entry count of a ``jax.jit``-wrapped callable (None when
+    the running jax build does not expose it — callers then fall back to
+    the logging counter)."""
+    fn = getattr(jitted, "_cache_size", None)
+    return fn() if callable(fn) else None
+
+
+def assert_compiles_once(jitted, make_args, steps: int = 3,
+                         label: str | None = None):
+    """Drive ``jitted`` through ``steps`` calls with fresh same-shaped args
+    and fail unless it compiled exactly once.
+
+    ``make_args(step) -> tuple`` builds each step's arguments — fresh
+    arrays each call, the way a real data loader feeds a train loop (a
+    sentinel fed the identical array object would miss dtype/weak-type
+    churn).  Raises :class:`RetraceError` with a one-line diagnostic
+    naming the entry point; returns the loop's compile count on success
+    (1 for a cold callable, 0 when this shape was already warmed before
+    the loop — both are healthy steady states; pre-existing cache entries
+    for *other* shapes are not charged to this loop).
+    """
+    label = label or getattr(jitted, "__name__", str(jitted))
+    # build every step's args up front: array construction can itself
+    # trigger tiny compiles that would pollute the fallback counter
+    all_args = [make_args(step) for step in range(steps)]
+    cache_before = _cache_size(jitted)
+    with CompileCounter() as counter:
+        for args in all_args:
+            jitted(*args)
+    cache_after = _cache_size(jitted)
+    if cache_before is not None and cache_after is not None:
+        compiles = cache_after - cache_before
+    else:
+        compiles = counter.total
+    if compiles > 1:
+        raise RetraceError(
+            f"{label}: {compiles} compilations across {steps} same-shape "
+            f"steps (expected at most 1) — a static arg, weak-typed "
+            f"scalar, or fresh closure is forcing a retrace per step "
+            f"[rule: compile-once]"
+        )
+    return compiles
+
+
+def audit_accumulator_dtypes() -> list[str]:
+    """Verify the flash kernels accumulate in float32 for sub-f32 inputs.
+
+    Returns a list of one-line violations (empty = clean): checks the XLA
+    path's online-softmax carry (``ops/flash.init_carry``) and the Pallas
+    partials' ``(acc, m, l)`` output dtypes, both under bf16 inputs, via
+    ``eval_shape`` — abstract evaluation only, no kernel runs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import flash, pallas_flash
+
+    violations: list[str] = []
+    b, h, n, d = 1, 2, 32, 8
+    q = jax.ShapeDtypeStruct((b, h, n, d), jnp.bfloat16)
+
+    carry = jax.eval_shape(
+        lambda q: flash.init_carry(b, h, 1, n, d, like=q), q
+    )
+    for name, leaf in zip(("acc", "m", "l"), jax.tree_util.tree_leaves(carry)):
+        if leaf.dtype != jnp.float32:
+            violations.append(
+                f"ops/flash.init_carry: {name} accumulates in {leaf.dtype}, "
+                f"contract says float32 [rule: f32-accumulator]"
+            )
+
+    parts = jax.eval_shape(
+        lambda q, k, v: pallas_flash.pallas_flash_partials(
+            q, k, v, scale=1.0, block_q=16, block_k=16, interpret=True,
+        ),
+        q, q, q,
+    )
+    for name, leaf in zip(("acc", "m", "l"), jax.tree_util.tree_leaves(parts)):
+        if leaf.dtype != jnp.float32:
+            violations.append(
+                f"ops/pallas_flash.pallas_flash_partials: {name} is "
+                f"{leaf.dtype}, contract says float32 [rule: f32-accumulator]"
+            )
+    return violations

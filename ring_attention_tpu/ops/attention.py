@@ -25,6 +25,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..utils import compat
+
 # Large-but-finite mask value: avoids the NaNs that -inf produces for
 # fully-masked rows (exp(-inf - -inf)).  The reference uses
 # ``-torch.finfo(dtype).max`` the same way.
@@ -88,7 +90,7 @@ def softclamp(x: jax.Array, value: float) -> jax.Array:
     return jnp.tanh(x / value) * value
 
 
-@partial(jax.jit, static_argnames=("causal", "softclamp_value"))
+@partial(compat.jit, static_argnames=("causal", "softclamp_value"))
 def default_attention(
     q: jax.Array,
     k: jax.Array,
@@ -116,9 +118,11 @@ def default_attention(
     Returns:
       ``(b, h, nq, d)`` attention output in ``q.dtype``.
     """
+    from ..utils.validate import check_attention_args
+
+    check_attention_args("default_attention", q, k, v, mask)
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
-    assert h % hk == 0, "query heads must be a multiple of kv heads"
     g = h // hk
     q_seg, kv_seg = normalize_segment_ids(segment_ids, q, k, "default_attention")
 

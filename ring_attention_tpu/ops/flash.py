@@ -194,7 +194,7 @@ def _bucket_xs(b, hk, nk, d, bucket_size, k, v, kv_mask, kv_seg):
     return xs
 
 
-def attend_blocks(
+def attend_blocks(  # ra: allow(RA007 mid-level block op; public entry points validate before the hop loop)
     q: jax.Array,  # (b, h, nq, d)
     k: jax.Array,  # (b, hk, nk, d)
     v: jax.Array,  # (b, hk, nk, d)

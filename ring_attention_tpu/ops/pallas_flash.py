@@ -940,7 +940,7 @@ def _flash_fwd_call(
     )
 
 
-def pallas_flash_partials(
+def pallas_flash_partials(  # ra: allow(RA007 per-hop kernel launch; ring/zigzag entry points validate first)
     q: jax.Array,  # (b, h, nq, d)
     k: jax.Array,  # (b, hk, nk, d)
     v: jax.Array,  # (b, hk, nk, d)
@@ -990,7 +990,7 @@ def pallas_flash_partials(
     )
 
 
-def pallas_flash_fused(
+def pallas_flash_fused(  # ra: allow(RA007 final-hop kernel launch; ring entry points validate first)
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
@@ -1104,6 +1104,7 @@ def pallas_flash_decode(
       f32 partials in the ``ops.flash.FlashCarry`` layout, for the
       tree-decode cross-device merge (``parallel/tree_decode.py``).
     """
+    check_attention_args("pallas_flash_decode", q, k, v, kv_mask)
     b, h, nq, d = q.shape
     hk = k.shape[1]
     g = h // hk

@@ -47,7 +47,8 @@ def axis_world(axis_name: str) -> int:
 
 def gather_sizes(size: jax.Array, axis_name: str) -> jax.Array:
     """All shards' sizes, shape ``(world,)`` (ref ``distributed.py:50-53``)."""
-    return lax.all_gather(jnp.asarray(size, jnp.int32), axis_name)
+    with jax.named_scope("collectives/gather_sizes"):
+        return lax.all_gather(jnp.asarray(size, jnp.int32), axis_name)
 
 
 def all_gather_variable(
@@ -77,7 +78,8 @@ def all_gather_variable(
     assert x.shape[axis] == max_size, "pad x to max_size before gathering"
     world = compat.axis_size(axis_name)
 
-    gathered = lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    with jax.named_scope("collectives/all_gather_variable"):
+        gathered = lax.all_gather(x, axis_name, axis=axis, tiled=True)
     lengths = gather_sizes(length, axis_name)  # (world,)
     slot = jnp.arange(world * max_size) % max_size
     owner = jnp.arange(world * max_size) // max_size

@@ -100,7 +100,7 @@ def _rotate(x, axis_name: str, shift: int = 1):
     # static, so this resolves at trace time)
     if compat.axis_size(axis_name) == 1:
         return x
-    return lax.ppermute(x, axis_name, _ring_perm(axis_name, shift))
+    return lax.ppermute(x, axis_name, _ring_perm(axis_name, shift))  # ra: allow(RA004 every caller wraps each rotation in its ring/rotate{i} hop scope)
 
 
 def _streams(bidirectional: bool, n_local: int) -> list[tuple[int, int, int]]:

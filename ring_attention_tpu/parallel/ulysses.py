@@ -58,15 +58,17 @@ def kv_head_reshard(
     hk = k.shape[1]
     world = compat.axis_size(axis_name)
     if hk % world == 0:
-        kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
-        vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        with jax.named_scope("kv_head_reshard/a2a"):
+            kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+            vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
         return kh, vh
     assert h % world == 0, f"query heads {h} must divide over {world} devices"
     g = h // hk  # query heads per kv head
     hql = h // world  # query heads per device
     rank = lax.axis_index(axis_name)
-    k_full = lax.all_gather(k, axis_name, axis=2, tiled=True)
-    v_full = lax.all_gather(v, axis_name, axis=2, tiled=True)
+    with jax.named_scope("kv_head_reshard/gather"):
+        k_full = lax.all_gather(k, axis_name, axis=2, tiled=True)
+        v_full = lax.all_gather(v, axis_name, axis=2, tiled=True)
     if hql <= g and g % hql == 0:
         # every query head on this device shares ONE kv head (hk divides
         # world): slice it — the ulysses flash (and any downstream ring)

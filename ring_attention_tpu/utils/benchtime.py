@@ -53,10 +53,11 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
 
 def fetch_rtt(samples: int = 3) -> float:
     """Seconds for one host<->device scalar fetch (min over ``samples``)."""
-    import jax
     import jax.numpy as jnp
 
-    f = jax.jit(lambda x: x + 1)
+    from . import compat
+
+    f = compat.jit(lambda x: x + 1)
     _ = float(f(jnp.float32(0)))  # compile outside the timed region
     best = float("inf")
     for i in range(samples):

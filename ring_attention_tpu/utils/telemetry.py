@@ -44,7 +44,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Iterator, NamedTuple
+from typing import Any, Iterator, NamedTuple
 
 # JSONL row schema version.  Bump when a field is renamed or its meaning
 # changes; adding fields is backward compatible and needs no bump.

@@ -1,0 +1,339 @@
+"""The static-analysis subsystem, tier-1: contracts, lint, retrace sentinel.
+
+Three layers of coverage:
+
+  - **positive contracts**: every sequence-parallel strategy's compiled
+    collective signature matches the declarative table on CPU meshes —
+    the generalized replacement for the old one-off HLO pins;
+  - **negative toys**: deliberately broken functions (an accidental
+    all-gather in a ring hot path, a collective under ``lax.cond``, a
+    retrace-per-step static arg, a compat-shim bypass) must each fail
+    their pass with a one-line diagnostic naming the violated rule;
+  - **self-runs**: the repo lint over ``ring_attention_tpu/`` and the f32
+    accumulator audit pin ZERO violations — the package stays clean by
+    construction.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ring_attention_tpu.analysis import (
+    RetraceError,
+    assert_compiles_once,
+    audit_accumulator_dtypes,
+    lint_package,
+    lint_source,
+)
+from ring_attention_tpu.analysis import contracts
+from ring_attention_tpu.parallel.mesh import SEQ_AXIS, create_mesh
+from ring_attention_tpu.parallel.ring import ring_flash_attention
+from ring_attention_tpu.utils import compat
+
+
+# ----------------------------------------------------------------------
+# Positive contracts: the strategy matrix on CPU meshes
+# ----------------------------------------------------------------------
+
+
+def _assert_ok(reports):
+    bad = [v for r in reports for v in r.violations]
+    assert not bad, "\n".join(bad)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "zigzag", "ulysses", "hybrid"])
+def test_contract_fwd_and_bwd(devices, strategy):
+    """Forward AND backward collective counts, axis discipline, and the
+    no-undeclared-collective rule on the canonical 8-device mesh."""
+    _assert_ok(contracts.check_strategy(strategy))
+
+
+@pytest.mark.parametrize("strategy", ["striped", "ulysses_gqa", "tree_decode"])
+def test_contract_fwd_only(devices, strategy):
+    """Single-direction strategies (striped shares the ring's backward
+    formula — its forward already pins the permutation-vs-count claim)."""
+    _assert_ok(contracts.check_strategy(strategy, directions=("fwd",)))
+
+
+def test_contract_ring_on_data_parallel_mesh(devices):
+    """A (data=2, seq=4) mesh: the ppermute pairs must keep the data
+    coordinate fixed — the axis rule with a non-trivial second axis."""
+    _assert_ok(contracts.check_strategy(
+        "ring", create_mesh(ring_size=4, data_size=2), directions=("fwd",),
+    ))
+
+
+def test_contract_hybrid_alternate_factoring(devices):
+    """ring=2 x ulysses=4: the other 8-device factoring (the table's count
+    expressions must track the mesh, not hard-code 4x2)."""
+    _assert_ok(contracts.check_strategy(
+        "hybrid", create_mesh(ulysses_size=4, ring_size=2),
+        directions=("fwd",),
+    ))
+
+
+def test_hybrid_hop_reduction_relation(devices):
+    """Acceptance: the hybrid contract PROVES ulysses-x fewer ring hops
+    than the pure ring at equal world size, from two compiled programs."""
+    report = contracts.check_hybrid_hop_reduction(world=8, ulysses=2)
+    assert report.ok, "\n".join(report.violations)
+    assert report.counts == {"hybrid_hops": 3, "pure_ring_hops": 7}
+
+
+@pytest.mark.parametrize("strategy", ["ring", "hybrid"])
+def test_scan_contract(devices, strategy):
+    """The traced (scanned-XLA) side: jaxpr collective counts with scan
+    bodies multiplied by trip count.  No XLA compile — make_jaxpr only."""
+    _assert_ok(contracts.check_scan_contract(strategy))
+
+
+def test_contract_table_is_documentation():
+    """The count expressions evaluate for arbitrary dims — the table can
+    be rendered straight into docs and stays arithmetic-only."""
+    dims = {"data": 1, "ring": 16, "ulysses": 4, "world": 64, "passes": 16}
+    assert contracts.expected_counts("ring", "fwd", dims) == {
+        "collective-permute": 15,
+    }
+    assert contracts.expected_counts("ring", "fwdbwd", dims) == {
+        "collective-permute": 46,  # (ring-1 fwd) + (ring-1 kv + ring dkv bwd)
+    }
+    assert contracts.expected_counts("hybrid", "fwd", dims) == {
+        "all-to-all": 4, "collective-permute": 15,
+    }
+
+
+# ----------------------------------------------------------------------
+# Negative toys: each pass must fail loudly, one line, naming its rule
+# ----------------------------------------------------------------------
+
+
+def test_accidental_all_gather_fails_contract(devices):
+    """A ring entry that also all-gathers K (the exact regression the
+    global no-undeclared-gather rule exists for) must fail with a one-line
+    diagnostic naming the collective-contract rule."""
+    mesh = create_mesh(ring_size=8)
+    spec = P("data", None, "seq", None)
+
+    def leaky(q, k, v):
+        out = ring_flash_attention(
+            q, k, v, None, SEQ_AXIS, causal=True, bucket_size=4,
+            impl="pallas",
+        )
+        # accidental O(seq) activation gather in the hot path
+        k_all = lax.all_gather(k, SEQ_AXIS, axis=2, tiled=True)
+        return out + k_all.mean() * 1e-9
+
+    fn = compat.shard_map(leaky, mesh=mesh, in_specs=(spec,) * 3,
+                          out_specs=spec, check_vma=False)
+    x = jnp.ones((1, 8, 64, 8), jnp.float32)
+    txt = compat.jit(fn).lower(x, x, x).compile().as_text()
+    dims = {"data": 1, "ring": 8, "ulysses": 1, "world": 8, "passes": 8}
+    violations = contracts.verify_hlo(
+        "ring", "fwd", txt, dims, mesh_shape=(1, 8),
+        axis_names=["data", "seq"],
+    )
+    assert len(violations) == 1
+    line = violations[0]
+    assert "\n" not in line
+    assert "all-gather" in line and "[rule: collective-contract]" in line
+
+
+def test_collective_inside_cond_fails(devices):
+    """A ppermute under lax.cond (a data-dependent collective schedule —
+    the SPMD deadlock hazard) is caught from jaxpr structure alone."""
+    mesh = create_mesh(ring_size=8)
+    spec = P("data", None, "seq", None)
+
+    def divergent(q):
+        rank = lax.axis_index(SEQ_AXIS)
+        perm = [(j, (j + 1) % 8) for j in range(8)]
+        return lax.cond(
+            rank % 2 == 0,
+            lambda x: lax.ppermute(x, SEQ_AXIS, perm),
+            lambda x: x,
+            q,
+        )
+
+    fn = compat.shard_map(divergent, mesh=mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False)
+    x = jnp.ones((1, 8, 64, 8), jnp.float32)
+    jc = contracts.jaxpr_collectives(jax.make_jaxpr(fn)(x))
+    assert jc.in_cond == ["ppermute"]
+
+
+def test_collective_inside_while_fails(devices):
+    """A ppermute under lax.while_loop: the trip count is unknown
+    statically, so the checker must flag it (never undercount it)."""
+    mesh = create_mesh(ring_size=8)
+    spec = P("data", None, "seq", None)
+
+    def dynamic(q):
+        perm = [(j, (j + 1) % 8) for j in range(8)]
+        return lax.while_loop(
+            lambda carry: carry[1] < 3,
+            lambda carry: (lax.ppermute(carry[0], SEQ_AXIS, perm),
+                           carry[1] + 1),
+            (q, 0),
+        )[0]
+
+    fn = compat.shard_map(dynamic, mesh=mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False)
+    x = jnp.ones((1, 8, 64, 8), jnp.float32)
+    jc = contracts.jaxpr_collectives(jax.make_jaxpr(fn)(x))
+    assert jc.in_while == ["ppermute"] and jc.dynamic
+
+
+def test_replica_groups_iota_form_parsed():
+    """The iota (v2) replica_groups spelling some XLA builds print must
+    parse to the same groups as the brace form — and an unknown format
+    must surface as a violation, never a silent pass."""
+    brace = "all-to-all.1 = f32[] all-to-all(x), replica_groups={{0,2},{1,3}}"
+    iota = "all-to-all.1 = f32[] all-to-all(x), replica_groups=[2,2]<=[4]"
+    iota_t = ("all-to-all.1 = f32[] all-to-all(x), "
+              "replica_groups=[2,2]<=[2,2]T(1,0)")
+    assert contracts._parse_replica_groups(brace) == [[0, 2], [1, 3]]
+    assert contracts._parse_replica_groups(iota) == [[0, 1], [2, 3]]
+    assert contracts._parse_replica_groups(iota_t) == [[0, 2], [1, 3]]
+    assert contracts._parse_replica_groups("all-to-all.1 = f32[] ...") is None
+
+    weird = "all-to-all.1 = f32[] all-to-all(x), replica_groups=<opaque>"
+    out = contracts.check_groups_axis(weird, "all-to-all", (2, 2), 1, "seq")
+    assert len(out) == 1 and "unrecognized replica_groups" in out[0]
+    # and the iota spelling passes/fails the axis rule like the brace one:
+    # groups [[0,1],[2,3]] on a (2, 2) mesh span exactly axis 1
+    assert contracts.check_groups_axis(iota, "all-to-all", (2, 2), 1, "seq") == []
+    assert contracts.check_groups_axis(iota, "all-to-all", (2, 2), 0, "data")
+
+
+def test_retrace_per_step_fails():
+    """A static arg that changes per step forces a recompile every call;
+    the sentinel names the entry point and the compile-once rule."""
+    bad = compat.jit(lambda x, n: x * n, static_argnums=(1,))
+    with pytest.raises(RetraceError) as err:
+        assert_compiles_once(bad, lambda step: (jnp.ones(8), step),
+                             steps=3, label="toy_step")
+    line = str(err.value)
+    assert "\n" not in line
+    assert "toy_step" in line and "[rule: compile-once]" in line
+    assert "3 compilations" in line
+
+
+def test_prewarmed_other_shape_not_charged():
+    """A cache entry from an earlier call at a DIFFERENT shape must not
+    count against the loop (the sentinel audits this loop's compiles, not
+    the callable's history); same-shape pre-warm is a healthy 0."""
+    f = compat.jit(lambda x: x * 2)
+    f(jnp.ones(4))  # pre-warm at another shape
+    assert assert_compiles_once(f, lambda s: (jnp.ones(8),), steps=3) == 1
+    assert assert_compiles_once(f, lambda s: (jnp.ones(8),), steps=3) == 0
+
+
+def test_entry_point_compiles_once():
+    """A real entry point (flash_attention) through the sentinel: three
+    same-shape steps with fresh arrays, exactly one compilation."""
+    from functools import partial
+
+    from ring_attention_tpu.ops.flash import flash_attention
+
+    step = compat.jit(partial(flash_attention, causal=True, bucket_size=16))
+
+    def make_args(step_i):
+        x = jnp.full((1, 2, 32, 8), 1.0 + step_i, jnp.float32)
+        return (x, x, x)
+
+    assert assert_compiles_once(step, make_args, steps=3) == 1
+
+
+def test_shim_bypass_fails_lint():
+    """The three shim-bypass spellings each produce exactly one RA001/2."""
+    src = textwrap.dedent("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def f(fn, mesh, specs):
+            return jax.experimental.shard_map.shard_map(
+                fn, mesh=mesh, in_specs=specs, out_specs=specs)
+
+        g = jax.jit(lambda x: x)
+    """)
+    violations = lint_source(src, "ring_attention_tpu/parallel/toy.py")
+    rules = [v.rule for v in violations]
+    assert rules.count("RA001") == 2 and rules.count("RA002") == 1
+    for v in violations:
+        assert "\n" not in str(v)
+        assert "compat" in v.message
+
+
+def test_lint_toy_violations_each_rule():
+    """One toy module tripping RA003-RA007, each a one-line diagnostic."""
+    src = textwrap.dedent("""
+        import time
+        from jax import lax
+        from jax.experimental import pallas as pl
+
+        def launch(x, kernel, spec):
+            return pl.pallas_call(kernel, out_shape=spec)(x)
+
+        def rotate(x):
+            return lax.ppermute(x, "seq", [(0, 1)])
+
+        def stamp(x):
+            print("step", time.time())
+            return x
+
+        def attention(q, k, v):
+            return q
+    """)
+    violations = lint_source(src, "ring_attention_tpu/ops/toy.py")
+    rules = sorted(v.rule for v in violations)
+    assert rules == ["RA003", "RA004", "RA005", "RA006", "RA007"]
+
+
+def test_lint_pragma_silences_with_reason():
+    src = 'from jax import lax\n' \
+          'def f(x):\n' \
+          '    return lax.psum(x, "seq")  # ra: allow(RA004 toy reason)\n'
+    assert lint_source(src, "ring_attention_tpu/parallel/toy.py") == []
+    bare = src.replace(" toy reason", "")
+    violations = lint_source(bare, "ring_attention_tpu/parallel/toy.py")
+    assert len(violations) == 1 and "reason is mandatory" in violations[0].message
+
+
+def test_lint_named_scope_satisfies_ra004():
+    src = textwrap.dedent("""
+        import jax
+        from jax import lax
+
+        def f(x):
+            with jax.named_scope("toy/rotate"):
+                return lax.ppermute(x, "seq", [(0, 1)])
+    """)
+    assert lint_source(src, "ring_attention_tpu/parallel/toy.py") == []
+
+
+# ----------------------------------------------------------------------
+# Self-runs: the package itself is clean
+# ----------------------------------------------------------------------
+
+
+def test_lint_self_run_zero_violations():
+    """The whole package tree passes its own lint — every fix that landed
+    with these rules stays landed."""
+    violations = lint_package()
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_accumulator_dtype_audit_clean():
+    """Both flash paths accumulate (acc, m, l) in f32 under bf16 inputs."""
+    assert audit_accumulator_dtypes() == []
+
+
+def test_collective_fingerprint_shape(devices):
+    """The bench-JSON fingerprint: per-strategy fwd collective counts,
+    cheap enough to ride along every bench round."""
+    fp = contracts.collective_fingerprint(strategies=("ring",))
+    assert fp == {"ring": {"ppermute": 7}, "contract_ok": True}

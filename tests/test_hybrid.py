@@ -15,7 +15,6 @@ crossing the ulysses axis) and number ``ulysses_size`` x fewer than the
 pure ring's at equal world size.
 """
 
-import re
 from functools import partial
 
 import jax
@@ -360,23 +359,20 @@ def test_hybrid_requires_factored_mesh(rng, meshes):
 # ----------------------------------------------------------------------
 
 
-_PERM = re.compile(r"collective-permute[^\n]*source_target_pairs=\{([0-9,{} ]*)\}")
-
-
-def _collective_permutes(txt: str) -> list[list[tuple[int, int]]]:
-    return [
-        [(int(a), int(b)) for a, b in re.findall(r"\{(\d+),(\d+)\}", m.group(1))]
-        for m in _PERM.finditer(txt)
-    ]
-
-
 def test_hybrid_hlo_hop_count(rng, meshes):
     """Optimized-HLO pin of the tentpole claim: at equal world size (8),
     the hybrid step's ring collective-permutes (the unrolled Pallas hop
     loop makes each hop a separate instruction) number ``ring_size - 1``
     — ulysses_size x fewer than the pure ring's ``world - 1`` — and every
     source->target pair keeps the ulysses coordinate fixed (the ring rides
-    ONLY the outer axis; the inner axis sees all-to-alls, not permutes)."""
+    ONLY the outer axis; the inner axis sees all-to-alls, not permutes).
+
+    Expectations and the pair-axis rule both come from the shared contract
+    checker (``analysis/contracts.py``): this pin holds the *module-level*
+    (flax, auto_shard) HLO to the same table the functional-core suite and
+    ``tools/check_contracts.py`` enforce, so they cannot drift apart."""
+    from ring_attention_tpu.analysis import contracts
+
     ulysses = 2
     hyb, _ = make_pair(meshes[(1, 2, 4)], causal=True, use_pallas=True,
                        bucket_size=8)
@@ -393,17 +389,26 @@ def test_hybrid_hlo_hop_count(rng, meshes):
             lambda p, x: mod.apply(p, x)
         ).lower(params, x).compile().as_text()
 
-    hops_hybrid = _collective_permutes(compiled(hyb))
-    hops_ring = _collective_permutes(compiled(ring))
+    hops_hybrid = contracts.hlo_ppermute_pairs(compiled(hyb))
+    hops_ring = contracts.hlo_ppermute_pairs(compiled(ring))
 
-    # pure ring at world 8: 7 hops; hybrid 2x4: 3 outer hops
-    assert len(hops_ring) == 8 - 1, len(hops_ring)
-    assert len(hops_hybrid) == (8 // ulysses) - 1, len(hops_hybrid)
+    # hop-count expectations from the ONE declarative table
+    hyb_dims = {"data": 1, "ring": 4, "ulysses": ulysses, "world": 8,
+                "passes": 4}
+    ring_dims = {"data": 1, "ring": 8, "ulysses": 1, "world": 8, "passes": 8}
+    want_hybrid = contracts.expected_counts(
+        "hybrid", "fwd", hyb_dims)["collective-permute"]
+    want_ring = contracts.expected_counts(
+        "ring", "fwd", ring_dims)["collective-permute"]
+    assert len(hops_ring) == want_ring == 8 - 1, len(hops_ring)
+    assert len(hops_hybrid) == want_hybrid == (8 // ulysses) - 1, (
+        len(hops_hybrid)
+    )
     assert len(hops_hybrid) * ulysses < len(hops_ring) + ulysses
 
-    # devices on the (1, ring, ulysses) mesh are laid out ulysses-minor:
-    # id = r * U + u, so a ring-only permute preserves id % U
-    for pairs in hops_hybrid:
-        assert pairs, "empty source_target_pairs"
-        for s, t in pairs:
-            assert s % ulysses == t % ulysses and s != t, (s, t)
+    # ring permutes must keep every non-ring mesh coordinate fixed — the
+    # checker's axis rule on the (data=1, ring=4, ulysses=2) mesh
+    violations = contracts.check_pairs_axis(
+        hops_hybrid, mesh_shape=(1, 4, 2), axis_index=1, axis_name="ring",
+    )
+    assert not violations, "\n".join(violations)

@@ -15,7 +15,7 @@ import pytest
 from ring_attention_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ring_attention_tpu.ops import default_attention, flash_attention
+from ring_attention_tpu.ops import default_attention
 from ring_attention_tpu.parallel import (
     create_mesh,
     ring_flash_attention,

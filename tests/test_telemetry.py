@@ -9,7 +9,6 @@ either exact (loss, grad_norm, counters), measured (step latency), or
 analytic-and-documented-as-such (MFU, hop/byte accounting).
 """
 
-import json
 import os
 import subprocess
 import sys
@@ -458,10 +457,12 @@ def test_metrics_add_no_collectives(rng, devices, guarded):
     as the uninstrumented one — telemetry derives every metric from values
     the step already computes.  (The unguarded baseline is compared with
     clipping on, which already computes the global grad norm the metrics
-    reuse.)"""
-    import re
-
+    reuse.)  The collective signature comes from the shared contract
+    checker (``analysis/contracts.py::hlo_collective_sequence``) so this
+    pin and the per-strategy contracts can never disagree on what counts
+    as a collective."""
     from ring_attention_tpu import RingTransformer, create_mesh
+    from ring_attention_tpu.analysis.contracts import hlo_collective_sequence
 
     mesh = create_mesh(ring_size=4)
     model = RingTransformer(
@@ -487,14 +488,10 @@ def test_metrics_add_no_collectives(rng, devices, guarded):
     )
     inst_args = (params, opt_state, init_train_metrics(), toks)
 
-    pat = re.compile(
-        r"(all-reduce|all-gather|all-to-all|collective-permute|"
-        r"reduce-scatter)\b"
-    )
     txt_base = jax.jit(base).lower(*base_args).compile().as_text()
     txt_inst = jax.jit(inst).lower(*inst_args).compile().as_text()
-    seq_base = [m.group(1) for m in pat.finditer(txt_base)]
-    seq_inst = [m.group(1) for m in pat.finditer(txt_inst)]
+    seq_base = hlo_collective_sequence(txt_base)
+    seq_inst = hlo_collective_sequence(txt_inst)
     assert seq_base, "expected ring collectives in the train step"
     if guarded:
         # signatures match (StepStats vs TrainMetrics carry): the compiled

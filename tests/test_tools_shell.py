@@ -25,6 +25,7 @@ TOOLS = sorted(glob.glob(os.path.join(REPO, "tools", "*.sh")))
 WATCHER = os.path.join(REPO, "tools", "tpu_window_watch.sh")
 KERNEL_VALIDATE = os.path.join(REPO, "tools", "tpu_kernel_validate.py")
 TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
+CHECK_CONTRACTS = os.path.join(REPO, "tools", "check_contracts.py")
 
 
 def test_tools_exist():
@@ -105,6 +106,72 @@ def test_trace_report_flags_parse():
     assert proc.returncode == 0, proc.stderr
     assert "--xprof" in proc.stdout
     assert "--last" in proc.stdout
+
+
+def test_check_contracts_compiles():
+    py_compile.compile(CHECK_CONTRACTS, doraise=True)
+
+
+def test_check_contracts_flags_parse():
+    """``check_contracts.py`` must keep its documented flag surface
+    (``--strategy/--mesh/--json``): argparse runs before any jax device
+    work, so this smoke needs no simulated mesh.  The full 20-contract
+    run lives in tests/test_analysis.py."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    for flag in ("--strategy", "--mesh", "--json", "--devices"):
+        assert flag in proc.stdout, f"{flag} missing from --help"
+
+
+def test_check_contracts_mesh_mismatch_is_a_diagnostic():
+    """A --mesh that fits none of the requested strategies must exit with
+    a one-line diagnostic, not a traceback (hybrid needs a factored
+    mesh); mixed requests skip the mismatches loudly instead of aborting
+    the run on the first incompatible strategy.  Argparse-level only: no
+    strategy compiles, so this stays cheap."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS,
+         "--strategy", "hybrid", "--mesh", "1x8"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode != 0
+    assert "Traceback" not in proc.stderr
+    assert "matched no requested strategy" in proc.stderr
+
+
+# ----------------------------------------------------------------------
+# Static analysis: the repo-native lint and ruff, alongside bash -n
+# ----------------------------------------------------------------------
+
+
+def test_repo_lint_self_run():
+    """The repo lint over the package tree exits clean — the python
+    analogue of ``bash -n``: every one-liner fix that landed with rules
+    RA001-RA007 stays landed.  Run in the script-path form, which is the
+    documented jax-free invocation (the ``-m`` form imports the package
+    ``__init__`` chain and therefore jax)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "ring_attention_tpu", "analysis", "lint.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, f"repo lint:\n{proc.stdout}{proc.stderr}"
+
+
+def test_ruff_if_available():
+    """``ruff check`` with the pyproject config (import hygiene + the
+    correctness subset the codebase already satisfies) — the shellcheck
+    pattern: enforced where the host has ruff, skipped where it doesn't."""
+    if shutil.which("ruff") is None:
+        pytest.skip("ruff not installed on this host")
+    proc = subprocess.run(
+        ["ruff", "check", "ring_attention_tpu", "tools", "tests", "bench.py"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, f"ruff:\n{proc.stdout}"
 
 
 # ----------------------------------------------------------------------

@@ -265,8 +265,6 @@ def test_variable_batch_gather_roundtrip(rng):
     """all_gather_variable feeds the padded-batch recipe: ragged per-device
     shards gather into (padded global, validity mask) whose real rows are
     exactly the unpadded examples — the mask is what example_mask consumes."""
-    from functools import partial
-
     from ring_attention_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 

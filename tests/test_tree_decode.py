@@ -8,7 +8,6 @@ reference's seq < world edge case, handled here with a static mask).
 
 from functools import partial
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -6,8 +6,6 @@ unpermuted sequence, for outputs and gradients, with rotary applied from
 explicit zig-zag positions.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
