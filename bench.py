@@ -181,6 +181,10 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
                        int(extra.get("world", extra.get("ring", 4))),
                        int(extra.get("ulysses", 2)))
         return
+    if mode == "counter":
+        _counter_worker(seq_len, int(extra.get("ring", 4)),
+                        extra.get("hop_compression"))
+        return
     if mode == "decode":
         _decode_worker(impl, seq_len, extra)
         return
@@ -433,6 +437,117 @@ def _hops_worker(seq_len: int, ring: int) -> None:
                 "seq_len": seq_len,
                 "ring": ring,
                 "impl": "pallas-hops",
+                "device": getattr(dev, "device_kind", str(dev)),
+                "ms_per_step": round(secs * 1e3, 2),
+                "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
+def _counter_worker(seq_len: int, ring: int, hop_compression: str | None) -> None:
+    """Single-chip simulation of the TokenRing counter-rotated hop chain.
+
+    The counter schedule's per-device COMPUTE is the same span sequence as
+    the baseline ring (pairing ``i`` attends the block ``i`` ranks behind
+    — ``parallel/ring.py::_counter_fwd``); what changes on hardware is the
+    communication (full-duplex split, int8 payloads).  This worker times
+    the compute chain the compressed variant actually executes — per-hop
+    int8 dequantization feeding the resumed span kernels — and reports
+    the ANALYTIC comms terms (bytes/hop for the compressed KV handle and
+    the f32 Q-pack, fwd/bwd collective counts) from
+    ``telemetry.ring_comms_accounting``, so the ``counter262k`` entry sits
+    next to ``ring_hops`` with directly comparable fields.  The collective
+    fingerprint (phase 0) pins the corresponding hop COUNTS from compiled
+    HLO even on wedged-TPU rounds.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops.pallas_flash import (
+        pallas_flash_fused,
+        pallas_flash_partials,
+    )
+    from ring_attention_tpu.parallel.collectives import (
+        dequantize_ring_payload,
+        quantize_ring_payload,
+    )
+    from ring_attention_tpu.utils.telemetry import ring_comms_accounting
+
+    dev, peak = _device_peak()
+    n_local = seq_len // ring
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, HEADS, n_local, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    scale = DIM_HEAD**-0.5
+
+    def hop_sequence(q):
+        if hop_compression != "int8":
+            return _hop_sequence(q, k, v, ring, n_local, scale)
+        handle = quantize_ring_payload(k, v)  # once at ring entry
+
+        def hop_kv(i):
+            j = ring - 1 - i
+            kh, vh = dequantize_ring_payload(
+                handle[:, :, :, j * n_local:(j + 1) * n_local], q.dtype
+            )
+            return kh, vh
+
+        kh, vh = hop_kv(0)
+        carry = pallas_flash_partials(
+            q, kh, vh, scale=scale, causal_offset=0,
+            block_q=1024, block_k=1024,
+        )
+        for i in range(1, ring - 1):
+            kh, vh = hop_kv(i)
+            carry = pallas_flash_partials(
+                q, kh, vh, scale=scale, block_q=1024, block_k=1024,
+                carry=carry,
+            )
+        kh, vh = hop_kv(ring - 1)
+        out, _ = pallas_flash_fused(
+            q, kh, vh, scale=scale, block_q=1024, block_k=1024, carry=carry,
+        )
+        return out
+
+    iters = 3
+
+    @jax.jit
+    def chained(q):
+        def body(carry, _):
+            o = hop_sequence(carry)
+            return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+
+        out, ys = jax.lax.scan(body, q, None, length=iters)
+        return ys.astype(jnp.float32).sum()
+
+    compile_s, secs = _timed(chained, (q,), iters)
+    flops = (
+        FWD_MATMULS * 2 * HEADS * DIM_HEAD * n_local * n_local * (ring - 0.5)
+    )
+    tflops = flops / secs / 1e12
+    comms = ring_comms_accounting(
+        ring_size=ring, seq_len=seq_len, kv_heads=HEADS, heads=HEADS,
+        dim_head=DIM_HEAD, dtype_bytes=2, counter_rotate=True,
+        hop_compression=hop_compression, peak_tflops=peak,
+    )
+    print(
+        json.dumps(
+            {
+                "value": round(tflops, 4),
+                "vs_baseline": round(tflops / peak, 4),
+                "mfu": round(tflops / peak, 4),
+                "seq_len": seq_len,
+                "ring": ring,
+                "hop_compression": hop_compression,
+                "hop_bytes": comms["hop_bytes"],
+                "q_pack_bytes": comms["q_pack_bytes"],
+                "fwd_collectives": comms["fwd_collectives"],
+                "bwd_collectives": comms["bwd_collectives"],
+                "hop_overlap_fraction": comms["hop_overlap_fraction"],
+                "tokens_per_sec": round(seq_len / secs),
+                "impl": "pallas-counter",
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),
@@ -1139,6 +1254,36 @@ def main() -> None:
                     payload["value"] / result["ring_hops_tflops"], 4
                 )
             log.append(f"hybrid:pallas@{TARGET_SEQ}[u2]: ok")
+        else:
+            log.append(err)
+
+    # phase 4d — TokenRing counter-rotation hop chain with int8-compressed
+    # KV payloads, at the same ring degree as phase 4's baseline.  The
+    # compute chain includes the per-hop dequant the compressed ring pays;
+    # bytes/hop + fwd/bwd collective counts ride along analytically, and
+    # phase 0's collective fingerprint pins the counter/compressed hop
+    # counts from compiled HLO even on wedged-TPU rounds.
+    if got_target and budget_left(900):
+        payload, err = _run_attempt(
+            "pallas", TARGET_SEQ, "counter",
+            min(900, deadline - time.monotonic()),
+            {"ring": 4, "hop_compression": "int8"},
+        )
+        if payload is not None:
+            result["counter262k"] = payload["value"]
+            result["counter_hop_bytes"] = payload["hop_bytes"]
+            result["counter_q_pack_bytes"] = payload["q_pack_bytes"]
+            result["counter_fwd_collectives"] = payload["fwd_collectives"]
+            result["counter_bwd_collectives"] = payload["bwd_collectives"]
+            result["counter_tokens_per_sec"] = payload["tokens_per_sec"]
+            result["counter_ms"] = payload["ms_per_step"]
+            if result.get("ring_hops_tflops"):
+                # dequant overhead of the compressed hop chain vs the
+                # model-dtype baseline hop chain on the same device
+                result["counter_vs_ring_hops"] = round(
+                    payload["value"] / result["ring_hops_tflops"], 4
+                )
+            log.append(f"counter:pallas@{TARGET_SEQ}[int8]: ok")
         else:
             log.append(err)
 

@@ -52,6 +52,15 @@ def main() -> None:
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     ap.add_argument("--bidirectional", action="store_true",
                     help="circulate KV halves both ring directions (duplex ICI)")
+    ap.add_argument("--counter-rotate", action="store_true",
+                    help="TokenRing full-duplex schedule: the Q shard + its "
+                         "online-softmax accumulators rotate one ring "
+                         "direction while KV rotates the other; the backward "
+                         "keeps KV/dKV resident (docs/ring_overlap.md)")
+    ap.add_argument("--hop-compression", choices=["int8"], default=None,
+                    help="ship forward KV ring hops int8-quantized (per-"
+                         "token absmax values + bitcast f32 scales in one "
+                         "payload); accumulators and grads stay exact-dtype")
     ap.add_argument("--pack", action="store_true",
                     help="packed-sequence training: concatenate variable-"
                          "length documents per row with segment ids — "
@@ -151,6 +160,8 @@ def main() -> None:
         sequence_parallel="hybrid" if hybrid else "ring",
         use_pallas=args.use_pallas,
         ring_bidirectional=args.bidirectional,
+        ring_counter_rotate=args.counter_rotate,
+        ring_hop_compression=args.hop_compression,
         remat=args.remat,
         loss_chunk_size=args.loss_chunk_size,
         dtype=jnp.bfloat16 if args.bf16 else None,
@@ -274,7 +285,8 @@ def main() -> None:
                 ring_size=ring, ulysses_size=ulysses, seq_len=pad_seq,
                 heads=4, kv_heads=4, dim_head=args.dim // 4,
                 dtype_bytes=2 if args.bf16 else 4, batch=args.batch,
-                depth=args.depth,
+                depth=args.depth, counter_rotate=args.counter_rotate,
+                hop_compression=args.hop_compression,
             )
         else:
             comms = {"ring_hops": 0, "ring_hops_per_step": 0, "hop_bytes": 0}

@@ -77,6 +77,82 @@ CONTRACTS: dict[str, dict[str, Any]] = {
             "fwdbwd": {"ppermute": "3 * passes"},
         },
     },
+    "counter": {
+        "description": "TokenRing counter-rotation (arXiv 2412.20501): the "
+                       "Q+(acc,m,l) pack rotates one ring direction while "
+                       "KV rotates the other (permute pairs in BOTH "
+                       "directions); backward circulates only the q-side "
+                       "pack with KV/dKV resident — fwd pays one extra "
+                       "collective (the out/lse catch-up) and the backward "
+                       "repays it: 2*ring per step vs the baseline 3*ring-2",
+        "impl": "pallas",
+        "mesh": "plain",
+        "ring_kwargs": {"counter_rotate": True},
+        "both_directions": True,
+        "axes": {"collective-permute": "seq"},
+        "hlo": {
+            "fwd": {"collective-permute": "ring"},
+            "fwdbwd": {"collective-permute": "2 * ring"},
+        },
+        "scan": {
+            # the single-lax.scan body covers two hops (one Q-rotation,
+            # one KV-rotation) + the out/lse catch-up; backward is one
+            # uniform ppermute per hop, landing home at full circulation
+            "fwd": {"ppermute": "2 * (passes // 2) + 1"},
+            "fwdbwd": {"ppermute": "2 * (passes // 2) + 1 + passes"},
+        },
+    },
+    "ring_compressed": {
+        "description": "int8-compressed KV hops: per-token absmax values + "
+                       "bitcast f32 scales in ONE payload — hop count "
+                       "identical to the ring contract, bytes/hop "
+                       "(d+4)/(4d) of the f32 ring's",
+        "impl": "pallas",
+        "mesh": "plain",
+        "ring_kwargs": {"hop_compression": "int8"},
+        "axes": {"collective-permute": "seq"},
+        "hlo": {
+            "fwd": {"collective-permute": "ring - 1"},
+            "fwdbwd": {"collective-permute": "3 * ring - 2"},
+        },
+        "scan": {
+            "fwd": {"ppermute": "passes"},
+            "fwdbwd": {"ppermute": "3 * passes"},
+        },
+        "hop_bytes": {
+            # every forward rotation moves the (2, b, hk, chunk, d+4) int8
+            # handle; backward recirculates exact kv + f32 dkv (its own
+            # larger payloads), so the pin is forward-only
+            "fwd": {
+                "min": "2 * b * kv_heads * chunk * (dim_head + 4)",
+                "max": "2 * b * kv_heads * chunk * (dim_head + 4)",
+            },
+        },
+    },
+    "counter_compressed": {
+        "description": "counter-rotation with int8 KV hops: counts match "
+                       "the counter contract exactly; the smallest "
+                       "circulating payload is the compressed KV handle",
+        "impl": "pallas",
+        "mesh": "plain",
+        "ring_kwargs": {"counter_rotate": True, "hop_compression": "int8"},
+        "both_directions": True,
+        "axes": {"collective-permute": "seq"},
+        "hlo": {
+            "fwd": {"collective-permute": "ring"},
+            "fwdbwd": {"collective-permute": "2 * ring"},
+        },
+        "scan": {
+            "fwd": {"ppermute": "2 * (passes // 2) + 1"},
+            "fwdbwd": {"ppermute": "2 * (passes // 2) + 1 + passes"},
+        },
+        "hop_bytes": {
+            "fwd": {
+                "min": "2 * b * kv_heads * chunk * (dim_head + 4)",
+                "max": "4 * b * heads * chunk * (2 * dim_head + 2)",
+            },
+        },
+    },
     "zigzag": {
         "description": "Llama-3 CP: gather K and V once; grads flow back "
                        "through the gather transpose (reduce-scatter)",
@@ -322,6 +398,10 @@ class JaxprCollectives:
     counts: dict[str, int] = field(default_factory=dict)
     in_cond: list[str] = field(default_factory=list)  # prims under lax.cond
     in_while: list[str] = field(default_factory=list)  # prims under lax.while
+    # bytes of each ppermute's payload (one entry per traced instruction,
+    # NOT multiplied by scan trip counts): the backend-independent
+    # bytes-per-hop signature the compression contracts pin
+    ppermute_bytes: list[int] = field(default_factory=list)
 
     @property
     def dynamic(self) -> bool:
@@ -363,6 +443,11 @@ def jaxpr_collectives(closed_jaxpr) -> JaxprCollectives:
                     res.in_cond.append(name)
                 if in_while:
                     res.in_while.append(name)
+                if name == "ppermute":
+                    aval = eqn.invars[0].aval
+                    res.ppermute_bytes.append(
+                        int(np.prod(aval.shape)) * aval.dtype.itemsize
+                    )
             if name == "scan":
                 walk(eqn.params["jaxpr"].jaxpr,
                      mult * int(eqn.params["length"]), in_cond, in_while)
@@ -489,6 +574,12 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
     kv_heads = contract.get("kv_heads", heads)
     striped = contract.get("striped", False)
     dims = _mesh_dims(mesh)
+    # shape dims join the namespace so hop-byte expressions read like the
+    # payload formulas they pin ("chunk" = the ring-leg KV block length)
+    dims.update(
+        b=b, heads=heads, kv_heads=kv_heads, seq=seq, dim_head=dim_head,
+        chunk=seq // dims["world"] * dims["ulysses"],
+    )
     if contract.get("mesh") == "factored" and not is_factored(mesh):
         raise ValueError(f"{strategy} needs a factored (data, ring, ulysses) "
                          "mesh — create_mesh(ulysses_size=...)")
@@ -506,11 +597,14 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
     rep = P(DATA_AXIS, None, None, None)
     bucket = max(seq // dims["world"] // 2, 4)
 
-    if strategy in ("ring", "striped"):
+    if strategy in ("ring", "striped", "counter", "ring_compressed",
+                    "counter_compressed"):
+        ring_kwargs = contract.get("ring_kwargs", {})
+
         def core(q, k, v):
             return ring_flash_attention(
                 q, k, v, None, SEQ_AXIS, causal=True, striped=striped,
-                bucket_size=bucket, impl=impl,
+                bucket_size=bucket, impl=impl, **ring_kwargs,
             )
         in_specs = (spec, spec, spec)
         out_specs = spec
@@ -613,7 +707,51 @@ def verify_hlo(strategy: str, direction: str, txt: str,
             violations.extend(check_groups_axis(
                 txt, kind, mesh_shape, axis_index, axis,
             ))
+
+    if contract.get("both_directions"):
+        axis = contract["axes"]["collective-permute"]
+        if axis in axis_names:
+            axis_index = axis_names.index(axis)
+            size = mesh_shape[axis_index]
+            shifts = set()
+            for ps in hlo_ppermute_pairs(txt):
+                for s, t in ps:
+                    cs = _device_coords(s, mesh_shape)
+                    ct = _device_coords(t, mesh_shape)
+                    shifts.add((ct[axis_index] - cs[axis_index]) % size)
+            if size > 1 and not {1, size - 1} <= shifts:
+                violations.append(
+                    f"{strategy}/{direction}: permute shifts {sorted(shifts)} "
+                    f"do not cover both ring directions (+1 and -1) — the "
+                    f"counter-rotation must load both full-duplex link "
+                    f"directions [rule: both-directions]"
+                )
     return violations
+
+
+def check_hop_bytes(strategy: str, direction: str, dims: dict[str, int],
+                    ppermute_bytes: list[int]) -> list[str]:
+    """Pin the smallest/largest circulating ppermute payload against the
+    contract's declared bytes-per-hop expressions (jaxpr-level avals —
+    backend-independent, immune to the CPU runtime's dtype promotions)."""
+    contract = CONTRACTS[strategy]
+    exprs = contract.get("hop_bytes", {}).get(direction)
+    if not exprs:
+        return []
+    if not ppermute_bytes:
+        return [f"{strategy}/{direction}: no ppermute payloads found but "
+                f"hop_bytes declared [rule: hop-bytes]"]
+    out = []
+    got = {"min": min(ppermute_bytes), "max": max(ppermute_bytes)}
+    for bound, expr in exprs.items():
+        want = int(eval(expr, {"__builtins__": {}}, dict(dims)))  # noqa: S307 - table-only
+        if got[bound] != want:
+            out.append(
+                f"{strategy}/{direction}: {bound} ppermute payload "
+                f"{got[bound]} bytes, contract says {want} ({expr!r} at "
+                f"{dims_str(dims)}) [rule: hop-bytes]"
+            )
+    return out
 
 
 def check_strategy(strategy: str, mesh=None, *, directions=None,
@@ -658,6 +796,9 @@ def check_strategy(strategy: str, mesh=None, *, directions=None,
         # traced structure: scan-aware counts + the no-collective-in-cond rule
         jc = jaxpr_collectives(jax.make_jaxpr(dfn)(*args))
         report.jaxpr_counts = jc.counts
+        report.violations.extend(check_hop_bytes(
+            strategy, direction, dims, jc.ppermute_bytes,
+        ))
         if jc.in_cond:
             report.violations.append(
                 f"{strategy}/{direction}: collective(s) {sorted(set(jc.in_cond))} "
@@ -779,6 +920,54 @@ def check_hybrid_hop_reduction(world: int | None = None, ulysses: int = 2,
     return report
 
 
+def check_counter_collective_budget(**shape_kw) -> ContractReport:
+    """The counter-rotation acceptance pin, proven from compiled programs:
+    a counter-rotated train step (fwd + bwd) issues NO MORE collectives
+    than the unidirectional baseline's — ``2 * ring`` vs ``3 * ring - 2``
+    (fwd alone pays one extra for the out/lse catch-up, ``ring`` vs
+    ``ring - 1``; the backward's resident-KV schedule repays it with
+    ``ring`` vs ``2 * ring - 1``)."""
+    import jax
+
+    from ..utils import compat
+
+    mesh = default_mesh("ring")
+    ring = _mesh_dims(mesh)["ring"]
+
+    def permutes(strategy, direction):
+        fn, args, _ = build_entry(strategy, mesh, **shape_kw)
+        dfn = _direction_fn(fn, direction)
+        txt = compat.jit(dfn).lower(*args).compile().as_text()
+        return hlo_collective_counts(txt).get("collective-permute", 0)
+
+    base_fwd = permutes("ring", "fwd")
+    base_step = permutes("ring", "fwdbwd")
+    ctr_fwd = permutes("counter", "fwd")
+    ctr_step = permutes("counter", "fwdbwd")
+
+    report = ContractReport(
+        strategy="counter_vs_ring", direction="fwdbwd", impl="pallas",
+        mesh_shape=tuple(mesh.shape.values()), dims={"ring": ring},
+        counts={"counter_fwd": ctr_fwd, "counter_step": ctr_step,
+                "baseline_fwd": base_fwd, "baseline_step": base_step},
+        expected={"counter_fwd": ring, "counter_step": 2 * ring,
+                  "baseline_fwd": ring - 1, "baseline_step": 3 * ring - 2},
+    )
+    for key, want in report.expected.items():
+        if report.counts[key] != want:
+            report.violations.append(
+                f"{key}: {report.counts[key]} collective-permutes, contract "
+                f"says {want} at ring={ring} [rule: counter-budget]"
+            )
+    if ctr_step > base_step:
+        report.violations.append(
+            f"counter-rotated step issues {ctr_step} collective-permutes, "
+            f"MORE than the unidirectional baseline's {base_step} "
+            f"[rule: counter-budget]"
+        )
+    return report
+
+
 def dims_str(dims: dict[str, int]) -> str:
     return ", ".join(f"{k}={v}" for k, v in sorted(dims.items()))
 
@@ -797,14 +986,21 @@ def run_contract_suite(strategies=None, *, scan: bool = True,
             reports.extend(check_scan_contract(strategy, **shape_kw))
     if "hybrid" in strategies and "ring" in strategies:
         reports.append(check_hybrid_hop_reduction(**shape_kw))
+    if "counter" in strategies and "ring" in strategies:
+        reports.append(check_counter_collective_budget(**shape_kw))
     return reports
 
 
-def collective_fingerprint(strategies=("ring", "ulysses", "hybrid")) -> dict:
+def collective_fingerprint(
+    strategies=("ring", "ulysses", "hybrid", "counter", "ring_compressed"),
+) -> dict:
     """Compact comms signature for the bench JSON: per-strategy forward
     collective counts from compiled HLO, so a perf trajectory catches a
     hop-count or accidental-gather regression even when tokens/sec moves
-    for other reasons."""
+    for other reasons.  The counter-rotation and int8-compressed ring
+    variants ride along so a comms regression in either shows up on a
+    wedged-TPU round too (the CPU fingerprint is the primary signal,
+    ROADMAP item 5)."""
     out: dict[str, Any] = {}
     ok = True
     for strategy in strategies:

@@ -125,6 +125,20 @@ class RingAttention(nn.Module):
     # None = float32 (exact); "bfloat16" halves backward ring bandwidth
     # (ref ring_flash_attention_cuda.py:255-260) at bf16 round-off cost
     ring_dkv_dtype: str | None = None
+    # TokenRing counter-rotation (arXiv 2412.20501): circulate the Q shard
+    # + its online-softmax accumulators one ring direction while the KV
+    # stream rotates the other — each full-duplex ICI direction carries
+    # about half the rotation traffic, and the backward drops the
+    # circulating dkv payload entirely (parallel/ring.py::_counter_fwd).
+    # Supersedes ring_bidirectional (the two schedules cannot compose —
+    # docs/ring_overlap.md); applies to the pure ring and the hybrid
+    # outer ring alike
+    ring_counter_rotate: bool = False
+    # "int8": ship each forward KV hop as per-token absmax int8 values +
+    # bitcast f32 scales in one payload — same hop count, ~dtype_bytes *
+    # d/(d+4)-x fewer bytes per hop; quantized once at ring entry, f32
+    # accumulators untouched (parallel/collectives.quantize_ring_payload)
+    ring_hop_compression: str | None = None
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -456,6 +470,8 @@ class RingAttention(nn.Module):
                 bidirectional=bidirectional,
                 dkv_dtype=self.ring_dkv_dtype,
                 segment_ids=seg,
+                counter_rotate=self.ring_counter_rotate,
+                hop_compression=self.ring_hop_compression,
             )
 
         qspec = P(DATA_AXIS, None, seq_partition(self.mesh), None)
@@ -493,6 +509,8 @@ class RingAttention(nn.Module):
                 "pallas" if self._use_pallas() else "xla",
                 bidirectional, self.ring_dkv_dtype,
                 segment_ids=seg,
+                counter_rotate=self.ring_counter_rotate,
+                hop_compression=self.ring_hop_compression,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
@@ -735,6 +753,8 @@ class RingAttention(nn.Module):
                 self.softclamp_value, None,
                 "pallas" if self._use_pallas() else "xla",
                 bidirectional, self.ring_dkv_dtype,
+                counter_rotate=self.ring_counter_rotate,
+                hop_compression=self.ring_hop_compression,
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)

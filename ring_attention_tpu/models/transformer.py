@@ -109,6 +109,9 @@ class RingTransformer(nn.Module):
     sequence_parallel: str = "ring"
     ring_bidirectional: bool = False  # see RingAttention.ring_bidirectional
     ring_dkv_dtype: str | None = None  # see RingAttention.ring_dkv_dtype
+    # see RingAttention.ring_counter_rotate / ring_hop_compression
+    ring_counter_rotate: bool = False
+    ring_hop_compression: str | None = None
     # rematerialize each block in backward: trades recompute for activation
     # memory — the standard recipe for quarter-million-token training.
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
@@ -182,6 +185,8 @@ class RingTransformer(nn.Module):
                 sequence_parallel=self.sequence_parallel,
                 ring_bidirectional=self.ring_bidirectional,
                 ring_dkv_dtype=self.ring_dkv_dtype,
+                ring_counter_rotate=self.ring_counter_rotate,
+                ring_hop_compression=self.ring_hop_compression,
                 dtype=self.dtype,
             )
             for lookback in self._lookbacks()

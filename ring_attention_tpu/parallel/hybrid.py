@@ -61,6 +61,8 @@ def hybrid_attention(
     bidirectional: bool = False,
     dkv_dtype: str | None = None,
     segment_ids: jax.Array | None = None,
+    counter_rotate: bool = False,
+    hop_compression: str | None = None,
 ) -> jax.Array:
     """2-D factored sequence-parallel exact attention; call inside
     ``shard_map`` over a ``(data, ring, ulysses)`` mesh (``ulysses``
@@ -85,9 +87,12 @@ def hybrid_attention(
     ``ring_size``); rotary positions must already be applied by the caller
     (``ops/rotary.py::hybrid_positions`` computes them from the combined
     rank).  All remaining knobs (``window`` / ``max_ring_passes`` /
-    ``bidirectional`` / ``dkv_dtype`` / ``impl``) pass straight through to
-    the ring leg and mean what they mean there, with ``n_local`` read as
-    the post-all-to-all chunk (``U x`` the resident shard).
+    ``bidirectional`` / ``dkv_dtype`` / ``counter_rotate`` /
+    ``hop_compression`` / ``impl``) pass straight through to the ring leg
+    and mean what they mean there, with ``n_local`` read as the
+    post-all-to-all chunk (``U x`` the resident shard) — in particular the
+    TokenRing counter-rotation and int8 hop compression apply to the OUTER
+    ring's hops, the only latency-chained collectives of the factoring.
 
     Returns the ``(b, h, n_local, d)`` output shard, in ``q.dtype``.
     """
@@ -130,7 +135,8 @@ def hybrid_attention(
             max_ring_passes=max_ring_passes, window=window,
             softclamp_value=softclamp_value, scale=scale, impl=impl,
             bidirectional=bidirectional, dkv_dtype=dkv_dtype,
-            segment_ids=seg_c,
+            segment_ids=seg_c, counter_rotate=counter_rotate,
+            hop_compression=hop_compression,
         )
 
     # head-sharded -> seq-sharded

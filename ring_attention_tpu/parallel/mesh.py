@@ -25,12 +25,62 @@ ULYSSES_AXIS = "ulysses"
 RING_AXIS = "ring"
 
 
+def _snake_coords(dims: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """Boustrophedon path through a grid: consecutive coordinates differ by
+    exactly 1 in exactly one axis.  On a torus the closing (last -> first)
+    hop rides the wraparound link of axis 0."""
+    if len(dims) == 1:
+        return [(i,) for i in range(dims[0])]
+    sub = _snake_coords(dims[1:])
+    out: list[tuple[int, ...]] = []
+    for i in range(dims[0]):
+        for tail in (sub if i % 2 == 0 else sub[::-1]):
+            out.append((i, *tail))
+    return out
+
+
+def torus_ring_order(devices: list) -> list | None:
+    """Devices reordered so consecutive entries are physical ICI neighbors.
+
+    Reads the TPU ``device.coords`` (the chip's position on the 2-D/3-D
+    torus) and threads a snake (boustrophedon) path through the grid:
+    every hop of a ring laid out in this order crosses exactly one ICI
+    link (TASP, arXiv 2509.26541 — the flat device order makes distant
+    ring ranks multi-hop stragglers that bound the whole ring's hop
+    latency).  Chips exposing multiple cores sit adjacent in the path
+    (same coords, consecutive ``core_on_chip``).
+
+    Returns None when the devices expose no usable coordinates (CPU /
+    simulated meshes) or do not fill a dense grid — callers fall back to a
+    deterministic flat order.
+    """
+    coords = []
+    for dev in devices:
+        c = getattr(dev, "coords", None)
+        if c is None:
+            return None
+        coords.append(tuple(int(x) for x in c))
+    dims = tuple(max(c[i] for c in coords) + 1 for i in range(len(coords[0])))
+    by_coord: dict[tuple[int, ...], list] = {}
+    for dev, c in zip(devices, coords):
+        by_coord.setdefault(c, []).append(dev)
+    if len(by_coord) != int(np.prod(dims)):
+        return None  # sparse / irregular slice: no dense snake exists
+    per_chip = {len(v) for v in by_coord.values()}
+    if len(per_chip) != 1:
+        return None
+    for devs in by_coord.values():
+        devs.sort(key=lambda d: getattr(d, "core_on_chip", 0) or 0)
+    return [d for c in _snake_coords(dims) for d in by_coord[c]]
+
+
 def create_mesh(
     ring_size: int | None = None,
     data_size: int | None = None,
     *,
     ulysses_size: int | None = None,
     devices: list | None = None,
+    ring_order: str = "auto",
 ) -> Mesh:
     """Build a ``(data, seq)`` mesh — or ``(data, ring, ulysses)`` when
     ``ulysses_size`` factors the sequence axis for hybrid 2-D sequence
@@ -42,16 +92,32 @@ def create_mesh(
     With ``ulysses_size=U``, ``ring_size`` is the OUTER ring degree and the
     sequence-parallel world is ``U * ring_size``.
 
-    On real TPU topologies the device order comes from
-    ``mesh_utils.create_device_mesh`` so the ``seq`` (ring) axis maps onto
-    physically adjacent ICI links — the per-hop ppermute then never crosses
-    DCN.  This replaces the reference's flat-rank assumption (its NCCL ring
-    order is whatever the launcher provided).  In the factored mesh the
-    ``ulysses`` axis is the innermost (fastest-varying) array dimension, so
-    the bandwidth-hungry all-to-all lands on the fastest-connected device
-    groups and the ring's per-hop ppermute rides the next tier out — the
-    TASP/TokenRing collective-to-link-tier matching (PAPERS.md).
+    ``ring_order`` controls how logical ring ranks map onto physical
+    devices:
+
+    - ``"auto"`` (default): topology-aware placement.  On TPU the device
+      coordinates thread a snake path through the torus
+      (:func:`torus_ring_order`) so neighboring ring ranks are physical
+      ICI neighbors — every hop of the per-hop ppermute crosses exactly
+      one link instead of the multi-hop stragglers a flat order produces
+      on v5p 3-D torus slices (TASP, arXiv 2509.26541).  When coords are
+      unusable it falls back to ``mesh_utils.create_device_mesh``, then to
+      the flat order; on CPU / simulated devices the fallback is the flat
+      sorted-by-id order, so "auto" is DETERMINISTIC everywhere.
+    - ``"flat"``: the plain device-list order (the reference's NCCL
+      flat-rank assumption) — the A/B baseline for placement shootouts.
+
+    In the factored mesh the ``ulysses`` axis is the innermost
+    (fastest-varying) array dimension, so the bandwidth-hungry all-to-all
+    lands on the closest-connected device groups and the ring's per-hop
+    ppermute rides the next tier out — the TASP/TokenRing
+    collective-to-link-tier matching (PAPERS.md).
     """
+    if ring_order not in ("auto", "flat"):
+        raise ValueError(
+            f'ring_order={ring_order!r}: want "auto" (topology-aware snake '
+            'over the TPU torus, deterministic flat fallback) or "flat"'
+        )
     explicit = devices is not None
     devices = devices if explicit else jax.devices()
     n = len(devices)
@@ -77,19 +143,29 @@ def create_mesh(
         )
         shape = (data_size, ring_size)
         axes = (DATA_AXIS, SEQ_AXIS)
-    if not explicit and devices and devices[0].platform == "tpu":
-        try:
-            from jax.experimental import mesh_utils
+    if ring_order == "auto" and devices and getattr(
+        devices[0], "platform", None
+    ) == "tpu":
+        ordered = torus_ring_order(devices)
+        if ordered is not None:
+            # row-major reshape puts consecutive snake neighbors along the
+            # innermost (fastest-varying) axis: ulysses groups sit on the
+            # closest links, ring ranks on adjacent ones
+            return Mesh(np.asarray(ordered).reshape(shape), axes)
+        if not explicit:
+            try:
+                from jax.experimental import mesh_utils
 
-            arr = mesh_utils.create_device_mesh(shape)
-            return Mesh(arr, axes)
-        except (ValueError, NotImplementedError) as e:
-            import warnings
+                arr = mesh_utils.create_device_mesh(shape)
+                return Mesh(arr, axes)
+            except (ValueError, NotImplementedError) as e:
+                import warnings
 
-            warnings.warn(
-                f"topology-aware device mesh unavailable ({e}); falling back "
-                "to flat device order — ring hops may cross non-adjacent links"
-            )
+                warnings.warn(
+                    f"topology-aware device mesh unavailable ({e}); falling "
+                    "back to flat device order — ring hops may cross "
+                    "non-adjacent links"
+                )
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, axes)
 

@@ -68,8 +68,9 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..utils import compat
-from ..ops.attention import normalize_segment_ids, segments_overlap
+from ..ops.attention import EPSILON, normalize_segment_ids, segments_overlap
 from ..ops.flash import (
+    FlashCarry,
     attend_blocks,
     finalize,
     flash_backward_blocks,
@@ -79,12 +80,14 @@ from ..ops.flash import (
     _ungroup,
 )
 from ..ops.pallas_flash import (
+    FlashPartials,
     _block_sizes,
     finalize_partials,
     pallas_flash_backward,
     pallas_flash_fused,
     pallas_flash_partials,
 )
+from .collectives import dequantize_ring_payload, quantize_ring_payload
 from ..utils.validate import check_attention_args
 
 
@@ -124,21 +127,92 @@ def _streams(bidirectional: bool, n_local: int) -> list[tuple[int, int, int]]:
     return [(1, 0, half), (-1, half, half)]
 
 
+def _kv_handle(k, v, hop_compression):
+    """Circulating KV payload: a stacked ``(2, b, hk, n, d)`` array in the
+    model dtype, or — with ``hop_compression="int8"`` — a single
+    ``(2, b, hk, n, d + 4)`` int8 array (values + bitcast f32 scale bytes)
+    quantized ONCE here and circulated unchanged (hops are lossless moves;
+    see ``collectives.quantize_ring_payload``).  Either way ONE array, so
+    every rotation is exactly one ``ppermute``."""
+    if hop_compression is None:
+        return jnp.stack([k, v])
+    return quantize_ring_payload(k, v)
+
+
+def _handle_kv(handle, dtype):
+    """The ``(k, v)`` a circulating handle represents, in ``dtype``."""
+    if handle.dtype == jnp.int8:
+        return dequantize_ring_payload(handle, dtype)
+    return handle[0], handle[1]
+
+
+def _handle_slice(handle, ofs, nk):
+    """Token-range slice of a handle (bidirectional half-streams).  The
+    compressed handle's per-row scale bytes ride the same token axis, so
+    half-streams slice ONE shared quantization pass."""
+    return handle[:, :, :, ofs:ofs + nk]
+
+
+def _pack_counter(q, acc, m, l):
+    """Flatten the counter-rotating Q-stream — the query block plus its
+    online-softmax accumulators — into ONE f32 array ``(b, h, n, 2d + 2)``
+    (channels ``[q | acc | m | l]``), so each Q-stream rotation is a single
+    ``ppermute``.  All inputs are ``(b, h, n, ·)``; sub-f32 ``q`` round-trips
+    through f32 bit-exactly, and the ``(acc, m, l)`` accumulators stay f32
+    end to end (``analysis/recompile.py::audit_accumulator_dtypes``)."""
+    return jnp.concatenate(
+        [q.astype(jnp.float32), acc, m[..., None], l[..., None]], axis=-1
+    )
+
+
+def _unpack_counter(pack, d, dtype):
+    """Inverse of :func:`_pack_counter`: ``(q, acc, m, l)``."""
+    return (
+        pack[..., :d].astype(dtype),
+        pack[..., d:2 * d],
+        pack[..., 2 * d],
+        pack[..., 2 * d + 1],
+    )
+
+
+def _counter_origins(rank, i, ring_size):
+    """``(q_origin, kv_origin)`` held by device ``rank`` at counter-rotation
+    hop ``i``.
+
+    The alternating schedule (Q-stream rotation with shift -1 after even
+    hops, KV rotation with shift +1 after odd hops) means that before hop
+    ``i`` the Q stream has moved ``ceil(i/2)`` times and the KV stream
+    ``floor(i/2)`` times; either rotation advances the pairing by one, so
+    ``q_origin - kv_origin ≡ i (mod ring)`` — hop ``i`` pairs each query
+    block with the KV block ``i`` ranks behind it, exactly the baseline
+    ring's visit order (windows and limited passes keep their semantics).
+
+    Works for traced and static ``i`` alike.
+    """
+    nq = (i + 1) // 2
+    nk = i // 2
+    return (rank + nq) % ring_size, (rank - nk) % ring_size
+
+
 def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask,
-                  segment_ids=None):
-    """Streams + their sliced KV stacks, mask shards, and kv segment-id
+                  segment_ids=None, hop_compression=None):
+    """Streams + their sliced KV handles, mask shards, and kv segment-id
     shards (fwd and bwd share this so the fallback condition and slice
     bounds can never diverge).  Segment ids circulate exactly like the
     mask: the queries keep the local ids, the kv ids ride the ring.
+    ``None`` payloads never enter the rotation state at all — an unmasked,
+    unpacked hop ppermutes exactly its KV handle and nothing else.
+
+    With ``hop_compression``, the whole block is quantized once and the
+    (half-)streams slice the shared int8 payload + scales, so
+    bidirectional halves ride one quantization pass.
 
     Limited passes never see the reverse stream's useful origins in time
     (see the ``bidirectional`` docstring) — run unidirectional instead.
     """
     streams = _streams(bidirectional and passes == ring_size, n_local)
-    kvs = tuple(
-        jnp.stack([k[:, :, ofs:ofs + nk], v[:, :, ofs:ofs + nk]])
-        for (_, ofs, nk) in streams
-    )
+    whole = _kv_handle(k, v, hop_compression)
+    kvs = tuple(_handle_slice(whole, ofs, nk) for (_, ofs, nk) in streams)
     masks = (
         tuple(kv_mask[:, ofs:ofs + nk] for (_, ofs, nk) in streams)
         if kv_mask is not None
@@ -371,7 +445,7 @@ def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
 def _ring_fwd_pallas(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     passes, window, softclamp_value, scale, bidirectional, ring_size, rank,
-    n_local,
+    n_local, hop_compression=None,
 ):
     """Pallas ring forward: unrolled hops with in-kernel accumulator resume.
 
@@ -393,7 +467,8 @@ def _ring_fwd_pallas(
     unused, and being outside any cond this is uniform across devices).
     """
     streams, kvs, masks, segs = _stream_state(
-        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids,
+        hop_compression,
     )
     n_spans = passes * len(streams)
     carry = None
@@ -417,14 +492,15 @@ def _ring_fwd_pallas(
                 hi, lo, hint = None, None, None
 
             blk_q, blk_k = _pallas_blocks(
-                bucket_size, q.shape[2], kvx[0].shape[2]
+                bucket_size, q.shape[2], stream[2]
             )
             seg_pair = None if sx is None else (segment_ids, sx)
 
             def partials(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
                          blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
+                kx, vx = _handle_kv(kvx, q.dtype)
                 return pallas_flash_partials(
-                    q, kvx[0], kvx[1], mx,
+                    q, kx, vx, mx,
                     scale=scale, causal_offset=hi, window_lo=lo,
                     softclamp_value=softclamp_value,
                     block_q=blk_q, block_k=blk_k,
@@ -436,8 +512,9 @@ def _ring_fwd_pallas(
 
                     def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
                              blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
+                        kx, vx = _handle_kv(kvx, q.dtype)
                         return pallas_flash_fused(
-                            q, kvx[0], kvx[1], mx,
+                            q, kx, vx, mx,
                             scale=scale, causal_offset=hi, window_lo=lo,
                             softclamp_value=softclamp_value,
                             block_q=blk_q, block_k=blk_k,
@@ -476,6 +553,314 @@ def _ring_fwd_pallas(
     return out, lse
 
 
+def _counter_static_band(i, n_local, causal, striped, window, ring_size):
+    """Trace-time ``(full, band_hint)`` for counter-rotation hop ``i``.
+
+    The pairing invariant ``q_origin - kv_origin ≡ i (mod ring)`` is
+    exactly the baseline forward stream's offset distribution (hop ``i``
+    of a ``shift=+1`` whole-block stream pairs each query block with the
+    KV block ``i`` ranks behind), so the static band description is shared
+    verbatim with :func:`_static_hop_band`."""
+    return _static_hop_band(
+        (1, 0, n_local), i, n_local, causal, striped, window, ring_size
+    )
+
+
+def _counter_fwd(
+    q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
+    passes, window, softclamp_value, scale, impl, ring_size, rank, n_local,
+    hop_compression,
+):
+    """TokenRing counter-rotation forward (arXiv 2412.20501).
+
+    Instead of pushing the whole KV payload through one ICI direction
+    every hop, the Q shard — packed with its online-softmax accumulators
+    ``(acc, m, l)`` into ONE f32 array (:func:`_pack_counter`) — rotates
+    with shift ``-1`` after even hops while the KV handle rotates with
+    shift ``+1`` after odd hops.  Either rotation advances the pairing
+    ``q_origin - kv_origin`` by one (:func:`_counter_origins`), so hop
+    ``i`` still attends the pairing the baseline ring visits at hop ``i``
+    (windows and limited passes keep their semantics), but consecutive
+    hops load opposite directions of the full-duplex links and each link
+    direction carries roughly half the rotation traffic.
+
+    ``impl="xla"`` runs the hops as a SINGLE ``lax.scan`` whose body
+    covers one Q-rotation and one KV-rotation (two hops) — the schedule is
+    uniform across devices and across iterations, so no collective ever
+    sits under a ``lax.cond`` (``analysis/contracts.py``); an odd
+    ``passes`` runs its trailing hop after the scan.  ``impl="pallas"``
+    unrolls the hops so the static band hints engage the compact causal
+    grid, resuming the ``(acc, m, l)`` carry in-kernel per hop.
+
+    After the last hop the finalized ``(out, lse)`` pack sits
+    ``passes // 2`` ranks from home (the Q-stream's net displacement);
+    one composed catch-up ppermute returns it — forward collectives total
+    ``passes`` vs the baseline's ``passes - 1``, repaid with interest by
+    the backward (:func:`_counter_bwd`), which needs only ``passes``
+    against the baseline's ``2 * passes - 1``.
+
+    Returns ``(out (b, h, n, d) q.dtype, lse (b, h, n) f32)`` — the lse is
+    FLAT (head-major) in both impls, unlike the baseline XLA path's
+    grouped layout; :func:`_ring_vjp_bwd` dispatches on ``counter_rotate``
+    before touching it.
+    """
+    b, h, n, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    kvh = _kv_handle(k, v, hop_compression)
+    mask, q_seg, kv_seg = kv_mask, segment_ids, segment_ids
+
+    def span(i, qx, acc, m, l, kvh, mask, q_seg, kv_seg):
+        """Fold pairing ``i`` into the flat ``(acc, m, l)`` accumulators."""
+        qo, ko = _counter_origins(rank, i, ring_size)
+        hi, lo = _hop_offsets(qo, ko, n_local, causal, striped, window,
+                              ring_size)
+        # has_work from the traced offsets BEFORE the full-span elision
+        # nulls them: the devices a "full" band excludes entirely are
+        # exactly the ones the cond must skip
+        has_work = _hop_has_work(hi, lo, n_local, n_local, q_seg, kv_seg)
+        hint = None
+        if isinstance(i, int):
+            full, hint = _counter_static_band(
+                i, n_local, causal, striped, window, ring_size
+            )
+            if full:
+                hi, lo, hint = None, None, None
+        seg_pair = None if q_seg is None else (q_seg, kv_seg)
+
+        def compute(args):
+            acc, m, l = args
+            kx, vx = _handle_kv(kvh, q.dtype)
+            if impl == "pallas":
+                blk_q, blk_k = _pallas_blocks(bucket_size, n, n)
+                p = pallas_flash_partials(
+                    qx, kx, vx, mask,
+                    scale=scale, causal_offset=hi, window_lo=lo,
+                    softclamp_value=softclamp_value,
+                    block_q=blk_q, block_k=blk_k, band_hint=hint,
+                    carry=None if acc is None else FlashPartials(acc, m, l),
+                    segment_ids=seg_pair,
+                )
+                return p.acc, p.m, p.l
+            carry = FlashCarry(
+                acc.reshape(b, hk, g, n, d),
+                m.reshape(b, hk, g, n),
+                l.reshape(b, hk, g, n),
+            )
+            carry = attend_blocks(
+                qx, kx, vx, carry,
+                scale=scale, bucket_size=_fit_bucket(bucket_size, n),
+                causal_offset=hi, window_lo=lo, kv_mask=mask,
+                softclamp_value=softclamp_value,
+                q_segment_ids=q_seg, kv_segment_ids=kv_seg,
+            )
+            return (
+                carry.acc.reshape(b, h, n, d),
+                carry.m.reshape(b, h, n),
+                carry.l.reshape(b, h, n),
+            )
+
+        if acc is None:
+            # hop 0 pairs every device's own (q, kv) block — always work,
+            # seeds the pallas carry without a cond (like _ring_fwd_pallas)
+            return compute((None, None, None))
+        return lax.cond(has_work, compute, lambda a: a, (acc, m, l))
+
+    if impl == "pallas":
+        qx, acc, m, l = q, None, None, None
+        for i in range(passes):
+            with jax.named_scope(f"ring/hop{i}"):
+                acc, m, l = span(i, qx, acc, m, l, kvh, mask, q_seg, kv_seg)
+            if i < passes - 1:
+                with jax.named_scope(f"ring/rotate{i}"):
+                    if i % 2 == 0:  # Q-stream hops one way...
+                        pack = _rotate(
+                            _pack_counter(qx, acc, m, l), axis_name, -1
+                        )
+                        qx, acc, m, l = _unpack_counter(pack, d, q.dtype)
+                        if q_seg is not None:
+                            q_seg = _rotate(q_seg, axis_name, -1)
+                    else:  # ...the KV stream hops the other
+                        kvh = _rotate(kvh, axis_name, 1)
+                        if mask is not None:
+                            mask = _rotate(mask, axis_name, 1)
+                        if kv_seg is not None:
+                            kv_seg = _rotate(kv_seg, axis_name, 1)
+    else:
+        carry0 = init_carry(b, hk, g, n, d, like=q)
+        pack = _pack_counter(
+            q,
+            carry0.acc.reshape(b, h, n, d),
+            carry0.m.reshape(b, h, n),
+            carry0.l.reshape(b, h, n),
+        )
+
+        def span_t(i, pack, kvh, mask, q_seg, kv_seg):
+            qx, acc, m, l = _unpack_counter(pack, d, q.dtype)
+            acc, m, l = span(i, qx, acc, m, l, kvh, mask, q_seg, kv_seg)
+            return _pack_counter(qx, acc, m, l)
+
+        def body(state, t):
+            pack, kvh, mask, q_seg, kv_seg = state
+            with jax.named_scope("ring/hop"):
+                pack = span_t(2 * t, pack, kvh, mask, q_seg, kv_seg)
+            # rotations AFTER compute, outside any cond: the collective
+            # schedule is identical on every device and every iteration
+            with jax.named_scope("ring/rotate"):
+                pack = _rotate(pack, axis_name, -1)
+                if q_seg is not None:
+                    q_seg = _rotate(q_seg, axis_name, -1)
+            with jax.named_scope("ring/hop"):
+                pack = span_t(2 * t + 1, pack, kvh, mask, q_seg, kv_seg)
+            with jax.named_scope("ring/rotate"):
+                kvh = _rotate(kvh, axis_name, 1)
+                if mask is not None:
+                    mask = _rotate(mask, axis_name, 1)
+                if kv_seg is not None:
+                    kv_seg = _rotate(kv_seg, axis_name, 1)
+            return (pack, kvh, mask, q_seg, kv_seg), None
+
+        state = (pack, kvh, mask, q_seg, kv_seg)
+        state, _ = lax.scan(body, state, jnp.arange(passes // 2))
+        pack, kvh, mask, q_seg, kv_seg = state
+        if passes % 2:
+            with jax.named_scope("ring/hop"):
+                pack = span_t(passes - 1, pack, kvh, mask, q_seg, kv_seg)
+        _, acc, m, l = _unpack_counter(pack, d, q.dtype)
+
+    out32 = acc / jnp.maximum(l, EPSILON)[..., None]
+    lse = m + jnp.log(jnp.maximum(l, EPSILON))
+    # the finalized rows belong to q_origin = rank + passes//2 (the
+    # Q-stream's net displacement): one composed ppermute returns the
+    # packed (out, lse) home
+    shift = (passes // 2) % ring_size
+    if shift:
+        ret = jnp.concatenate([out32, lse[..., None]], axis=-1)
+        with jax.named_scope("ring/catchup"):
+            ret = _rotate(ret, axis_name, shift)
+        out32, lse = ret[..., :d], ret[..., d]
+    return out32.astype(q.dtype), lse
+
+
+def _counter_bwd(
+    do, q, k, v, kv_mask, segment_ids, out, lse, axis_name, causal, striped,
+    bucket_size, passes, window, softclamp_value, scale, impl, ring_size,
+    rank, n_local,
+):
+    """Counter-rotation backward: the Q-side circulates, KV and dKV rest.
+
+    The forward's pairing order only has to be *covered*, not replayed, so
+    the backward uses the cheapest schedule that covers it: ONE f32 pack
+    ``[q | do | dq | lse | delta]`` (``(b, h, n, 3d + 2)``) rotates with
+    shift ``-1`` every hop — a single ppermute, a uniform ``lax.scan``
+    body on the XLA path — while ``(k, v)`` and the f32 ``(dk, dv)``
+    accumulators stay RESIDENT on their owner shard.  Each visiting query
+    block adds its contribution to the local dk/dv directly, so the
+    baseline's second circulating payload (f32 dkv, ~2x the kv bytes) and
+    its catch-up rotation disappear entirely: ``passes`` collectives vs
+    the baseline backward's ``2 * passes - 1``.  After a full circulation
+    the pack is home (its dq included); limited passes catch the dq
+    channel up with one composed ppermute.
+    """
+    b, h, n, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    pack = jnp.concatenate(
+        [
+            q.astype(jnp.float32),
+            do.astype(jnp.float32),
+            match_vma(jnp.zeros((b, h, n, d), jnp.float32), q),
+            lse[..., None],
+            delta[..., None],
+        ],
+        axis=-1,
+    )
+    dk = match_vma(jnp.zeros((b, hk, n, d), jnp.float32), q)
+    dv = match_vma(jnp.zeros((b, hk, n, d), jnp.float32), q)
+
+    def span(i, pack, dk, dv, q_seg):
+        qx = pack[..., :d].astype(q.dtype)
+        dox = pack[..., d:2 * d].astype(q.dtype)
+        lse_x = pack[..., 3 * d]
+        delta_x = pack[..., 3 * d + 1]
+        qo = (rank + i) % ring_size  # pure Q-rotation: pairing i = hop i
+        hi, lo = _hop_offsets(qo, rank, n_local, causal, striped, window,
+                              ring_size)
+        # has_work BEFORE the full-span elision nulls the offsets (the
+        # excluded devices are the ones the cond must skip)
+        has_work = _hop_has_work(hi, lo, n_local, n_local, q_seg,
+                                 segment_ids)
+        hint = None
+        if isinstance(i, int):
+            full, hint = _counter_static_band(
+                i, n_local, causal, striped, window, ring_size
+            )
+            if full:
+                hi, lo, hint = None, None, None
+        if impl == "pallas":
+            lse_s, delta_s = lse_x, delta_x  # flat (b, h, n)
+        else:
+            lse_s = lse_x.reshape(b, hk, g, n)
+            delta_s = delta_x.reshape(b, hk, g, n)
+
+        def work(args):
+            dqc, dk, dv = args
+            dq_i, dk_i, dv_i = _span_bwd(
+                impl, dox, qx, k, v, lse_s, delta_s, kv_mask, hi, lo,
+                scale, bucket_size, softclamp_value, hk, hint,
+                q_seg, segment_ids,
+            )
+            return (
+                dqc + dq_i.astype(jnp.float32),
+                dk + dk_i.astype(jnp.float32),
+                dv + dv_i.astype(jnp.float32),
+            )
+
+        dqc, dk, dv = lax.cond(
+            has_work, work, lambda a: a, (pack[..., 2 * d:3 * d], dk, dv)
+        )
+        pack = jnp.concatenate(
+            [pack[..., :2 * d], dqc, pack[..., 3 * d:]], axis=-1
+        )
+        return pack, dk, dv
+
+    if impl == "pallas":
+        q_seg = segment_ids
+        for i in range(passes):
+            with jax.named_scope(f"ring/bwd_hop{i}"):
+                pack, dk, dv = span(i, pack, dk, dv, q_seg)
+            if i < passes - 1:
+                with jax.named_scope("ring/rotate"):
+                    pack = _rotate(pack, axis_name, -1)
+                    if q_seg is not None:
+                        q_seg = _rotate(q_seg, axis_name, -1)
+        disp = (passes - 1) % ring_size
+    else:
+
+        def body(state, i):
+            pack, dk, dv, q_seg = state
+            with jax.named_scope("ring/bwd_hop"):
+                pack, dk, dv = span(i, pack, dk, dv, q_seg)
+            with jax.named_scope("ring/rotate"):
+                pack = _rotate(pack, axis_name, -1)
+                if q_seg is not None:
+                    q_seg = _rotate(q_seg, axis_name, -1)
+            return (pack, dk, dv, q_seg), None
+
+        (pack, dk, dv, _), _ = lax.scan(
+            body, (pack, dk, dv, segment_ids), jnp.arange(passes)
+        )
+        disp = passes % ring_size
+
+    # only the dq channel still needs delivering: catch it up alone
+    dq = pack[..., 2 * d:3 * d]
+    if disp:
+        with jax.named_scope("ring/catchup"):
+            dq = _rotate(dq, axis_name, disp)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def ring_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -493,6 +878,8 @@ def ring_flash_attention(
     bidirectional: bool = False,
     dkv_dtype: str | None = None,
     segment_ids: jax.Array | None = None,
+    counter_rotate: bool = False,
+    hop_compression: str | None = None,
 ) -> jax.Array:
     """Sequence-parallel exact attention; call inside ``shard_map``.
 
@@ -543,6 +930,24 @@ def ring_flash_attention(
         bf16 round-off per hop-accumulate — measured grad error vs f32
         stays within ~2e-2 on unit-variance inputs
         (``tests/test_ring.py::test_ring_dkv_bf16_circulation``).
+      counter_rotate: TokenRing full-duplex scheme (arXiv 2412.20501): the
+        Q shard packed with its online-softmax accumulators rotates one
+        ring direction while the KV stream rotates the other, alternating
+        hops, so each ICI direction carries about half the rotation
+        traffic (:func:`_counter_fwd`); the backward circulates only the
+        q-side pack with KV and the f32 dk/dv accumulators resident
+        (:func:`_counter_bwd` — fewer collectives AND fewer bytes than the
+        baseline backward).  Supersedes ``bidirectional`` — a KV half
+        co-moving with the Q stream never advances its pairing, so the two
+        schedules cannot compose (``docs/ring_overlap.md`` derives this);
+        requesting both warns and runs pure counter-rotation.
+      hop_compression: ``"int8"`` ships each forward KV hop as per-token
+        symmetric-absmax int8 values + bitcast f32 scales in ONE payload —
+        hop counts unchanged, hop bytes ~``dtype_bytes * d / (d + 4)``-x
+        smaller (``collectives.quantize_ring_payload``).  Quantized once
+        at ring entry (hops are lossless moves); the backward recomputes
+        from the exact residual ``(k, v)``, and every ``(acc, m, l)`` /
+        dk/dv accumulator stays f32 (``audit_accumulator_dtypes``).
 
     Cross-attention (unequal q/kv shard lengths) silently bypasses the ring
     and runs local flash over the local KV shard — the reference degrades
@@ -556,6 +961,22 @@ def ring_flash_attention(
         None if segment_ids is None else (segment_ids, segment_ids),
         q, q, "ring_flash_attention",
     )
+    if hop_compression not in (None, "int8"):
+        raise ValueError(
+            f"hop_compression={hop_compression!r}: supported values are "
+            'None (model-dtype hops) and "int8" (per-token absmax '
+            "quantized hops)"
+        )
+    if counter_rotate and bidirectional:
+        # a KV half-stream co-moving with the Q stream never advances its
+        # pairing (docs/ring_overlap.md) — the schedules cannot compose,
+        # and counter-rotation already loads both link directions
+        warnings.warn(
+            "counter_rotate already saturates both ICI directions; "
+            "ignoring bidirectional half-streams",
+            stacklevel=2,
+        )
+        bidirectional = False
     if q.shape[2] != k.shape[2]:
         # Cross-attention: each device attends its local KV shard only,
         # exactly like the reference's non-ring fallback.  The causal band
@@ -583,24 +1004,24 @@ def ring_flash_attention(
     return _ring_flash_attention_core(
         q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
         bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
-        bidirectional, dkv_dtype,
+        bidirectional, dkv_dtype, counter_rotate, hop_compression,
     )
 
 
 @partial(
     jax.custom_vjp,
-    nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17),
 )
 def _ring_flash_attention_core(
     q, k, v, kv_mask, segment_ids, axis_name, causal=False, striped=False,
     bucket_size=None, max_ring_passes=None, window=None,
     softclamp_value=None, scale=None, impl="xla", bidirectional=False,
-    dkv_dtype=None,
+    dkv_dtype=None, counter_rotate=False, hop_compression=None,
 ):
     out, _ = _ring_fwd_impl(
         q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
         bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
-        bidirectional,
+        bidirectional, counter_rotate, hop_compression,
     )
     return out
 
@@ -608,6 +1029,7 @@ def _ring_flash_attention_core(
 def _ring_fwd_impl(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
+    counter_rotate=False, hop_compression=None,
 ):
     if window is not None:
         assert causal, "lookback windows require causal attention"
@@ -619,11 +1041,21 @@ def _ring_fwd_impl(
     passes = min(max_ring_passes or ring_size, ring_size)
     rank = lax.axis_index(axis_name)
 
+    if counter_rotate:
+        out, lse = _counter_fwd(
+            q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
+            bucket_size, passes, window, softclamp_value, scale, impl,
+            ring_size, rank, n_local, hop_compression,
+        )
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
+        return out, lse
+
     if impl == "pallas":
         out, lse = _ring_fwd_pallas(
             q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
             bucket_size, passes, window, softclamp_value, scale,
-            bidirectional, ring_size, rank, n_local,
+            bidirectional, ring_size, rank, n_local, hop_compression,
         )
         out = checkpoint_name(out, "flash_out")
         lse = checkpoint_name(lse, "flash_lse")
@@ -635,7 +1067,8 @@ def _ring_fwd_impl(
     carry = init()
     # one stacked (k, v) message per stream per hop, ref ring_flash_attention.py:129
     streams, kvs, masks, segs = _stream_state(
-        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids
+        bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids,
+        hop_compression,
     )
 
     def hop(i, flash, kvs, masks, segs):
@@ -650,14 +1083,11 @@ def _ring_fwd_impl(
             has_work = _hop_has_work(hi, lo, n_local, stream[2],
                                      segment_ids, sx)
             with jax.named_scope("ring/hop"):  # hop index is traced here
-                flash = lax.cond(
-                    has_work,
-                    lambda f, kvx=kvx, mx=mx, hi=hi, lo=lo, sx=sx: attend(
-                        f, kvx[0], kvx[1], mx, hi, lo, sx
-                    ),
-                    lambda f: f,
-                    flash,
-                )
+                def att(f, kvx=kvx, mx=mx, hi=hi, lo=lo, sx=sx):
+                    kx, vx = _handle_kv(kvx, q.dtype)
+                    return attend(f, kx, vx, mx, hi, lo, sx)
+
+                flash = lax.cond(has_work, att, lambda f: f, flash)
             # rotate AFTER compute; collective outside the cond so the
             # schedule is uniform across devices
             with jax.named_scope("ring/rotate"):
@@ -691,19 +1121,20 @@ def _ring_fwd_impl(
 def _ring_vjp_fwd(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
-    dkv_dtype,
+    dkv_dtype, counter_rotate, hop_compression,
 ):
     out, lse = _ring_fwd_impl(
         q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
         bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
-        bidirectional,
+        bidirectional, counter_rotate, hop_compression,
     )
     return out, (q, k, v, kv_mask, segment_ids, out, lse)
 
 
 def _ring_vjp_bwd(
     axis_name, causal, striped, bucket_size, max_ring_passes, window,
-    softclamp_value, scale, impl, bidirectional, dkv_dtype, res, do,
+    softclamp_value, scale, impl, bidirectional, dkv_dtype, counter_rotate,
+    hop_compression, res, do,
 ):
     q, k, v, kv_mask, segment_ids, out, lse = res
     b, h, n_local, d = q.shape
@@ -713,6 +1144,18 @@ def _ring_vjp_bwd(
     ring_size = compat.axis_size(axis_name)
     passes = min(max_ring_passes or ring_size, ring_size)
     rank = lax.axis_index(axis_name)
+
+    if counter_rotate:
+        # the counter forward's lse is flat (b, h, n) for both impls; the
+        # backward circulates the q-side pack with KV/dKV resident — the
+        # forward's hop_compression never enters (grads recompute from the
+        # exact residual k/v)
+        dq, dk, dv = _counter_bwd(
+            do, q, k, v, kv_mask, segment_ids, out, lse, axis_name, causal,
+            striped, bucket_size, passes, window, softclamp_value, scale,
+            impl, ring_size, rank, n_local,
+        )
+        return dq, dk, dv, None, None
 
     if impl == "pallas":
         # lse/delta in (b, h, n) layout

@@ -525,6 +525,8 @@ def ring_comms_accounting(
     causal: bool = True,
     peak_tflops: float | None = None,
     ici_gbps: float | None = None,
+    counter_rotate: bool = False,
+    hop_compression: str | None = None,
 ) -> dict[str, Any]:
     """Topology-aware per-step communication accounting for a
     (ring x ulysses) sequence-parallel factoring (TASP, arXiv 2509.26541).
@@ -539,6 +541,11 @@ def ring_comms_accounting(
       "ulysses x fewer hops" claim as a logged number.
     - ``hop_bytes`` — K+V bytes ppermuted per hop per device (the ring
       circulates kv-head-sized blocks of the post-all-to-all chunk).
+      With ``hop_compression="int8"`` the payload is int8 values + four
+      bitcast f32 scale bytes per ``(head, token)`` row, so this shrinks
+      ``dtype_bytes * dim_head / (dim_head + 4)``-fold (~3.8x from f32 at
+      d=64; the contract ``analysis/contracts.py`` pins the same formula
+      against traced payloads).
     - ``ring_bytes_per_step`` — per device, forward only; backward
       circulates (k, v) plus f32 (dk, dv) accumulators (~3x with default
       ``dkv_dtype``), reported as ``ring_bytes_per_step_bwd``.
@@ -549,9 +556,28 @@ def ring_comms_accounting(
       over max(compute, transfer at ICI bandwidth): 1.0 means the hop's
       flash compute fully hides the transfer (the overlap the reference
       lacks); < 1.0 means the ring is transfer-bound at these shapes.
+
+    ``counter_rotate=True`` accounts the TokenRing schedule
+    (``parallel/ring.py::_counter_fwd``): the forward alternates Q-pack
+    rotations (f32 ``[q | acc | m | l]``, reported as ``q_pack_bytes``)
+    one ring direction with KV rotations the other, plus one out/lse
+    catch-up; the backward circulates only the q-side pack with KV and
+    dKV resident.  Extra keys:
+
+    - ``fwd_collectives`` / ``bwd_collectives`` — ppermutes per attention
+      call (baseline ``passes - 1`` / ``2 * (passes - 1) + 1``; counter
+      ``passes`` / ``passes`` — one extra forward, repaid in backward).
+    - ``fwd_link_direction_bytes`` — the busier ICI direction's forward
+      rotation traffic per device: the counter schedule splits the
+      payloads across both full-duplex directions, the baseline loads one.
     """
     if heads is None:
         heads = kv_heads
+    if hop_compression not in (None, "int8"):
+        raise ValueError(
+            f"ring_comms_accounting: hop_compression={hop_compression!r}; "
+            'want None or "int8" (parallel/ring.py accepts the same values)'
+        )
     world = ring_size * ulysses_size
     if seq_len % world:
         raise ValueError(
@@ -565,14 +591,53 @@ def ring_comms_accounting(
     n_chunk = seq_len // ring_size  # what the ring circulates / attends
     hops = max(passes - 1, 0)
     pure_ring_hops = max(world - 1, 0)
-    # the ring moves the device's kv-head block of the chunk each hop
+    # the ring moves the device's kv-head block of the chunk each hop;
+    # int8 compression ships 1-byte values + 4 bitcast f32 scale bytes
+    # per (head, token) row in the same single payload
     kv_heads_local = max(kv_heads // max(ulysses_size, 1), 1)
-    hop_bytes = 2 * batch * kv_heads_local * n_chunk * dim_head * dtype_bytes
-    ring_bytes = hops * hop_bytes
-    # backward: (k, v) in model dtype + (dk, dv) accumulated in f32
-    ring_bytes_bwd = hops * (hop_bytes + 2 * batch * kv_heads_local
-                             * n_chunk * dim_head * 4)
+    if hop_compression == "int8":
+        hop_bytes = 2 * batch * kv_heads_local * n_chunk * (dim_head + 4)
+    else:
+        hop_bytes = (
+            2 * batch * kv_heads_local * n_chunk * dim_head * dtype_bytes
+        )
     heads_local = max(heads // max(ulysses_size, 1), 1)
+    if counter_rotate:
+        # forward: ceil((P-1)/2) Q-pack rotations one direction,
+        # floor((P-1)/2) KV rotations the other, + one out/lse catch-up
+        # (f32 [out | lse], rides the KV direction as a composed permute)
+        q_pack_bytes = 4 * batch * heads_local * n_chunk * (2 * dim_head + 2)
+        q_rots = (passes - 1 + 1) // 2 if passes > 1 else 0
+        kv_rots = (passes - 1) // 2
+        catchup = (
+            4 * batch * heads_local * n_chunk * (dim_head + 1)
+            if (passes // 2) % max(ring_size, 1)
+            else 0
+        )
+        fwd_collectives = hops + (1 if catchup else 0)
+        ring_bytes = q_rots * q_pack_bytes + kv_rots * hop_bytes + catchup
+        fwd_dir_bytes = max(
+            q_rots * q_pack_bytes, kv_rots * hop_bytes + catchup
+        )
+        # backward: ONE f32 [q | do | dq | lse | delta] pack circulates;
+        # (k, v) and the f32 (dk, dv) accumulators stay resident
+        bwd_pack = 4 * batch * heads_local * n_chunk * (3 * dim_head + 2)
+        bwd_collectives = passes
+        ring_bytes_bwd = hops * bwd_pack + (
+            4 * batch * heads_local * n_chunk * dim_head  # dq catch-up
+        )
+        worst_hop_bytes = max(hop_bytes, q_pack_bytes)
+    else:
+        q_pack_bytes = 0
+        fwd_collectives = hops
+        bwd_collectives = max(2 * passes - 1, 0)
+        ring_bytes = hops * hop_bytes
+        fwd_dir_bytes = ring_bytes  # everything rides one link direction
+        # backward recirculates exact-dtype (k, v) + f32 (dk, dv): the
+        # compressed forward payload never enters the backward ring
+        kv_exact = 2 * batch * kv_heads_local * n_chunk * dim_head
+        ring_bytes_bwd = hops * (kv_exact * dtype_bytes + kv_exact * 4)
+        worst_hop_bytes = hop_bytes
     n_local = seq_len // world
     a2a_bytes = (
         2 * batch * heads * n_local * dim_head * dtype_bytes
@@ -601,15 +666,23 @@ def ring_comms_accounting(
         except Exception:  # noqa: BLE001
             ici_gbps = ICI_GBPS["v5e"]
     compute_s = hop_flops / (peak_tflops * 1e12)
-    transfer_s = hop_bytes / (ici_gbps * 1e9)
+    # the counter schedule's worst rotation is whichever circulating
+    # payload is larger (Q-pack vs KV handle); baseline it's the KV hop
+    transfer_s = worst_hop_bytes / (ici_gbps * 1e9)
     overlap = compute_s / max(compute_s, transfer_s, 1e-30)
     return {
         "ring_size": ring_size,
         "ulysses_size": ulysses_size,
+        "counter_rotate": counter_rotate,
+        "hop_compression": hop_compression,
         "ring_hops": hops,
         "pure_ring_hops": pure_ring_hops,
         "ring_hops_per_step": hops * depth * 2,  # fwd + bwd rings
         "hop_bytes": hop_bytes,
+        "q_pack_bytes": q_pack_bytes,
+        "fwd_collectives": fwd_collectives,
+        "bwd_collectives": bwd_collectives,
+        "fwd_link_direction_bytes": fwd_dir_bytes * depth,
         "ring_bytes_per_step": ring_bytes * depth,
         "ring_bytes_per_step_bwd": ring_bytes_bwd * depth,
         "a2a_bytes_per_step": a2a_bytes * depth * 2,

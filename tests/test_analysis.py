@@ -52,6 +52,56 @@ def test_contract_fwd_and_bwd(devices, strategy):
     _assert_ok(contracts.check_strategy(strategy))
 
 
+def test_contract_counter(devices):
+    """The TokenRing counter-rotation row: exact hop counts fwd AND bwd
+    from compiled HLO, permute pairs in BOTH ring directions (the
+    both-directions rule), zero undeclared collective kinds, and the
+    scan-multiplied jaxpr counts — all on 8 virtual CPU devices."""
+    _assert_ok(contracts.check_strategy("counter"))
+    _assert_ok(contracts.check_scan_contract("counter"))
+
+
+@pytest.mark.parametrize(
+    "strategy", ["ring_compressed", "counter_compressed"]
+)
+def test_contract_compressed(devices, strategy):
+    """The int8-compressed rows: compressed bytes/hop pinned from the
+    traced ppermute avals (the hop-bytes rule) plus forward HLO counts;
+    the fwd+bwd hop counts are pinned at the jaxpr level by the scan
+    contract (backward recomputes from exact residuals, so its HLO is
+    the ring/counter contract already compiled above — kept out of the
+    fast tier; tools/check_contracts.py --strategy all runs it)."""
+    _assert_ok(contracts.check_strategy(strategy, directions=("fwd",)))
+    _assert_ok(contracts.check_scan_contract(strategy))
+
+
+def test_counter_collective_budget(devices):
+    """Acceptance: the counter-rotated step issues NO MORE collectives
+    than the unidirectional baseline, proven from compiled HLO — fwd pays
+    one extra (the out/lse catch-up: ring vs ring-1) and the resident-KV
+    backward repays it (2*ring vs 3*ring-2 per step)."""
+    report = contracts.check_counter_collective_budget()
+    assert report.ok, "\n".join(report.violations)
+    ring = report.dims["ring"]
+    assert report.counts["counter_step"] == 2 * ring
+    assert report.counts["baseline_step"] == 3 * ring - 2
+    assert report.counts["counter_step"] < report.counts["baseline_step"]
+
+
+def test_counter_contract_catches_missing_direction(devices):
+    """The both-directions rule is live: verifying the UNIDIRECTIONAL
+    ring's HLO against the counter contract (which demands permute pairs
+    in both ring directions) must fail naming the rule."""
+    mesh = contracts.default_mesh("ring")
+    fn, args, dims = contracts.build_entry("ring", mesh)
+    txt = compat.jit(fn).lower(*args).compile().as_text()
+    violations = contracts.verify_hlo(
+        "counter", "fwd", txt, dims, tuple(mesh.shape.values()),
+        list(mesh.shape.keys()),
+    )
+    assert any("both-directions" in v for v in violations), violations
+
+
 @pytest.mark.parametrize("strategy", ["striped", "ulysses_gqa", "tree_decode"])
 def test_contract_fwd_only(devices, strategy):
     """Single-direction strategies (striped shares the ring's backward

@@ -182,3 +182,49 @@ def test_module_lookback_striped(rng, mesh):
     np.testing.assert_allclose(
         ring_mod.apply(params, x), ref_mod.apply(params, x), atol=ATOL
     )
+
+
+def test_module_counter_and_compression_plumbing(rng, mesh, monkeypatch):
+    """ring_counter_rotate / ring_hop_compression reach the ring call in
+    the module's RING branch (not just hybrid) — the exact bug class a
+    dropped kwarg produces.  A recording stub stands in for
+    ring_flash_attention so the pin costs one cheap local-flash compile;
+    the full counter+int8 numerics through the module are the slow test
+    below, and function-level parity lives in tests/test_ring.py."""
+    from ring_attention_tpu.models import attention as attn_mod
+    from ring_attention_tpu.ops.flash import flash_attention
+
+    seen = {}
+
+    def stub(q, k, v, mask, axis_name, *args, **kwargs):
+        seen.update(kwargs)
+        return flash_attention(q, k, v, mask, causal=True, bucket_size=8)
+
+    monkeypatch.setattr(attn_mod, "ring_flash_attention", stub)
+    ring_mod, ref_mod = make_pair(
+        mesh, causal=True, ring_counter_rotate=True,
+        ring_hop_compression="int8",
+    )
+    x = jnp.asarray(rng.standard_normal((2, 32, 32)), jnp.float32)
+    params = ref_mod.init(jax.random.PRNGKey(0), x)
+    ring_mod.apply(params, x)
+    assert seen.get("counter_rotate") is True
+    assert seen.get("hop_compression") == "int8"
+
+
+@pytest.mark.slow
+def test_module_counter_rotate_with_compression(rng, mesh):
+    """Full numerics through the module: counter-rotation + int8 hops
+    stay within the single-quantization envelope of the oracle, and the
+    output provably differs from the exact oracle (compression actually
+    engaged)."""
+    ring_mod, ref_mod = make_pair(
+        mesh, causal=True, ring_counter_rotate=True,
+        ring_hop_compression="int8",
+    )
+    x = jnp.asarray(rng.standard_normal((2, 32, 32)), jnp.float32)
+    params = ref_mod.init(jax.random.PRNGKey(0), x)
+    ref = ref_mod.apply(params, x)
+    out = ring_mod.apply(params, x)
+    assert not np.allclose(out, ref, atol=1e-7)  # compression engaged
+    np.testing.assert_allclose(out, ref, atol=2.5e-2)

@@ -137,3 +137,134 @@ def test_shard_batch_places_on_mesh(rng):
     assert placed["weights"].sharding.spec == P("data")
     assert int(placed["step"]) == 3  # scalar leaf replicates
     np.testing.assert_array_equal(np.asarray(t), np.asarray(tokens))
+
+
+# ----------------------------------------------------------------------
+# int8 ring-hop payload quantization (hop_compression="int8")
+# ----------------------------------------------------------------------
+
+
+def test_ring_payload_quant_roundtrip(rng):
+    """quantize -> dequantize reconstructs (k, v) within one int8 step of
+    the per-(head, token) absmax scale, and the payload is ONE int8 array
+    whose last axis carries values + 4 bitcast f32 scale bytes."""
+    from ring_attention_tpu.parallel.collectives import (
+        dequantize_ring_payload,
+        quantize_ring_payload,
+    )
+
+    k = jnp.asarray(rng.standard_normal((2, 4, 16, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 4, 16, 8)), jnp.float32)
+    payload = quantize_ring_payload(k, v)
+    assert payload.dtype == jnp.int8
+    assert payload.shape == (2, 2, 4, 16, 8 + 4)
+    k2, v2 = dequantize_ring_payload(payload, jnp.float32)
+    # one quantization step = scale (absmax/127) per row
+    for exact, got in ((k, k2), (v, v2)):
+        step = np.asarray(jnp.abs(exact).max(axis=-1)) / 127.0
+        err = np.abs(np.asarray(got - exact)).max(axis=-1)
+        np.testing.assert_array_less(err, step + 1e-7)
+
+
+def test_ring_payload_token_slices_share_scales(rng):
+    """Slicing the payload along tokens (bidirectional half-streams) keeps
+    each row's scale bytes with its values: dequantizing a slice equals
+    slicing the dequantization."""
+    from ring_attention_tpu.parallel.collectives import (
+        dequantize_ring_payload,
+        quantize_ring_payload,
+    )
+
+    k = jnp.asarray(rng.standard_normal((1, 2, 16, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 16, 8)), jnp.float32)
+    payload = quantize_ring_payload(k, v)
+    k_half, v_half = dequantize_ring_payload(payload[:, :, :, :8], jnp.float32)
+    k_full, v_full = dequantize_ring_payload(payload, jnp.float32)
+    np.testing.assert_array_equal(k_half, k_full[:, :, :8])
+    np.testing.assert_array_equal(v_half, v_full[:, :, :8])
+
+
+# ----------------------------------------------------------------------
+# Topology-aware ring placement (create_mesh(ring_order="auto"))
+# ----------------------------------------------------------------------
+
+
+class _FakeTpu:
+    """Just enough device surface for torus_ring_order."""
+
+    platform = "tpu"
+
+    def __init__(self, coords, core=0):
+        self.coords = coords
+        self.core_on_chip = core
+
+    def __repr__(self):
+        return f"tpu{self.coords}/{self.core_on_chip}"
+
+
+def test_snake_coords_are_ici_neighbors():
+    """Every consecutive pair in the boustrophedon path differs by exactly
+    1 in exactly one torus axis — each ring hop is one physical link."""
+    from ring_attention_tpu.parallel.mesh import _snake_coords
+
+    for dims in ((4,), (2, 4), (2, 2, 2), (4, 2, 2)):
+        path = _snake_coords(dims)
+        assert len(path) == int(np.prod(dims))
+        assert len(set(path)) == len(path)
+        for a, b in zip(path, path[1:]):
+            diff = [abs(x - y) for x, y in zip(a, b)]
+            assert sum(diff) == 1, f"{a} -> {b} is not one ICI hop"
+
+
+def test_torus_ring_order_snakes_a_3d_slice():
+    """A shuffled 2x2x2 v5p-like slice comes back in snake order: every
+    consecutive pair of chips is one link apart (TASP placement)."""
+    from ring_attention_tpu.parallel.mesh import torus_ring_order
+
+    devs = [
+        _FakeTpu((x, y, z))
+        for x in range(2) for y in range(2) for z in range(2)
+    ]
+    shuffled = [devs[i] for i in (5, 0, 3, 6, 1, 4, 7, 2)]
+    ordered = torus_ring_order(shuffled)
+    assert ordered is not None and len(ordered) == 8
+    for a, b in zip(ordered, ordered[1:]):
+        diff = [abs(x - y) for x, y in zip(a.coords, b.coords)]
+        assert sum(diff) == 1
+
+
+def test_torus_ring_order_multicore_chips_adjacent():
+    """Chips exposing two cores keep both cores adjacent in the path."""
+    from ring_attention_tpu.parallel.mesh import torus_ring_order
+
+    devs = [
+        _FakeTpu((x, y), core)
+        for x in range(2) for y in range(2) for core in (1, 0)
+    ]
+    ordered = torus_ring_order(devs)
+    assert ordered is not None
+    for i in range(0, 8, 2):
+        a, b = ordered[i], ordered[i + 1]
+        assert a.coords == b.coords and (a.core_on_chip, b.core_on_chip) == (0, 1)
+
+
+def test_torus_ring_order_falls_back():
+    """No coords (CPU) or a sparse slice -> None, so create_mesh uses the
+    deterministic flat order instead of a bogus snake."""
+    from ring_attention_tpu.parallel.mesh import torus_ring_order
+
+    assert torus_ring_order(jax.devices()) is None  # CPU: no coords
+    sparse = [_FakeTpu((0, 0)), _FakeTpu((1, 1))]
+    assert torus_ring_order(sparse) is None
+
+
+def test_create_mesh_ring_order_validation_and_determinism():
+    """ring_order accepts only "auto"/"flat"; on CPU both give the same
+    deterministic mesh (auto's fallback is the flat sorted order)."""
+    with pytest.raises(ValueError, match="ring_order"):
+        create_mesh(ring_size=8, ring_order="snake")
+    auto = create_mesh(ring_size=8, ring_order="auto")
+    flat = create_mesh(ring_size=8, ring_order="flat")
+    assert (np.asarray(auto.devices) == np.asarray(flat.devices)).all()
+    again = create_mesh(ring_size=8, ring_order="auto")
+    assert (np.asarray(auto.devices) == np.asarray(again.devices)).all()

@@ -473,3 +473,386 @@ def test_ring_dkv_bf16_circulation(rng, mesh, impl):
     for a, b, name in zip(g_bf16[1:], g_f32[1:], "kv"):
         np.testing.assert_allclose(a, b, atol=2e-2, rtol=2e-2,
                                    err_msg=f"d{name}")
+
+
+# ----------------------------------------------------------------------
+# TokenRing counter-rotation (arXiv 2412.20501): the Q shard + its
+# (acc, m, l) accumulators circulate one ring direction while the KV
+# stream rotates the other; the backward keeps KV and dKV resident.
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("striped", [False, True])
+def test_ring_counter_parity(rng, mesh, striped):
+    """Counter-rotation visits the same (q_origin, kv_origin) pairings as
+    the baseline ring (hop i pairs each query block with the KV block i
+    ranks behind), so outputs must match the oracle in both causal
+    layouts (the non-causal path rides test_ring_counter_kv_mask)."""
+    q, k, v = make_qkv(rng)
+    ref = default_attention(q, k, v, causal=True)
+    out = ring_attn_global(
+        q, k, v, mesh=mesh, causal=True, striped=striped, bucket_size=8,
+        counter_rotate=True,
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+def test_ring_counter_grads(rng, mesh):
+    """Backward with the q-side pack circulating and KV/dKV resident: dq
+    comes home with the pack, dk/dv accumulate in place on the owner
+    shard (GQA — the group-sum is the harder case; full heads ride the
+    same path and are covered by the slow pallas test and the fuzz)."""
+    q, k, v = make_qkv(rng, hk=2)
+
+    def loss_ref(q, k, v):
+        return (default_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (
+            ring_attn_global(
+                q, k, v, mesh=mesh, causal=True, bucket_size=8,
+                counter_rotate=True,
+            )
+            ** 2
+        ).sum()
+
+    g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+def test_ring_counter_window_limited_passes(rng, mesh):
+    """Counter-rotation preserves the baseline's pairing-visit ORDER, so
+    max_ring_passes + sliding windows keep their semantics; the dq
+    catch-up must land limited-pass grads on the owner shard."""
+    q, k, v = make_qkv(rng)
+    w = 32
+    oracle = banded_oracle(w)
+
+    def ring(q, k, v):
+        return ring_attn_global(
+            q, k, v, mesh=mesh, causal=True, bucket_size=8, window=w,
+            max_ring_passes=4, counter_rotate=True,
+        )
+
+    np.testing.assert_allclose(ring(q, k, v), oracle(q, k, v), atol=ATOL)
+    g_ref = jax.grad(lambda *a: (oracle(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda *a: (ring(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+@pytest.mark.slow
+def test_ring_counter_kv_mask(rng, mesh):
+    """The key-padding mask rides the KV stream (opposite the Q pack).
+    Slow tier: the kv-side payload rotation is the same code path the
+    fast packed-segment test exercises with kv segment ids."""
+    q, k, v = make_qkv(rng)
+    mask = jnp.asarray(rng.random((2, 128)) > 0.3)
+    ref = default_attention(q, k, v, mask)
+    out = ring_attn_global(
+        q, k, v, mask, mesh=mesh, bucket_size=8, counter_rotate=True
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+def test_ring_counter_packed_segments(rng, mesh):
+    """Packed documents under counter-rotation: the q-side segment ids
+    circulate WITH the Q pack while the kv ids ride the KV stream — the
+    cross-document mask and the no-shared-document hop skip must follow
+    both streams, fwd and bwd."""
+    q, k, v = make_qkv(rng)
+    n = q.shape[2]
+    ids = np.zeros(n, np.int32)
+    for doc, start in enumerate((0, 48, 96)):
+        ids[start:] = doc
+    seg = jnp.asarray(np.broadcast_to(ids, (2, n)).copy())
+
+    def per_doc(q, k, v):
+        outs = []
+        for lo, hi in ((0, 48), (48, 96), (96, n)):
+            outs.append(default_attention(
+                q[:, :, lo:hi], k[:, :, lo:hi], v[:, :, lo:hi], causal=True
+            ))
+        return jnp.concatenate(outs, axis=2)
+
+    def counter(q, k, v):
+        fn = partial(
+            ring_flash_attention, axis_name="seq", causal=True,
+            bucket_size=8, counter_rotate=True,
+        )
+        qspec = P("data", None, "seq", None)
+        return shard_map(
+            lambda q, k, v, s: fn(q, k, v, None, segment_ids=s),
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec, P("data", "seq")),
+            out_specs=qspec,
+        )(q, k, v, seg)
+
+    np.testing.assert_allclose(counter(q, k, v), per_doc(q, k, v), atol=ATOL)
+    # grads — the q-side ids circulating WITH the pack through the
+    # backward's pure-Q rotation is the novel packed-counter logic
+    g_ref = jax.grad(lambda *a: (per_doc(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda *a: (counter(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+@pytest.mark.slow
+def test_ring_counter_data_axis(rng, mesh2x4):
+    """Counter-rotation inside ring sets: ppermute over the seq sub-axis
+    of a (data, seq) mesh scopes per mesh row, both directions.  (Slow
+    tier: the per-row scoping is also pinned structurally by the contract
+    axis-discipline rule on the 2x4 mesh.)"""
+    q, k, v = make_qkv(rng)
+    ref = default_attention(q, k, v, causal=True)
+    out = ring_attn_global(
+        q, k, v, mesh=mesh2x4, causal=True, bucket_size=8,
+        counter_rotate=True,
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+
+def test_ring_counter_supersedes_bidirectional(rng, mesh):
+    """counter_rotate + bidirectional cannot compose (a KV half co-moving
+    with the Q stream never advances its pairing): requesting both warns
+    and runs pure counter-rotation.  The warning fires at TRACE time, so
+    eval_shape under shard_map pins it without compiling or running (the
+    counter schedule's numerics are covered by the parity tests)."""
+    q, k, v = make_qkv(rng)
+
+    def fn(q, k, v):
+        return ring_flash_attention(
+            q, k, v, None, "seq", causal=True, bucket_size=8,
+            counter_rotate=True, bidirectional=True,
+        )
+
+    qspec = P("data", None, "seq", None)
+    sharded = shard_map(
+        fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec
+    )
+    with pytest.warns(UserWarning, match="counter_rotate"):
+        out_shape = jax.eval_shape(sharded, q, k, v)
+    assert out_shape.shape == q.shape
+
+
+@pytest.mark.slow
+def test_ring_counter_pallas(rng, mesh):
+    """Counter-rotation through the unrolled Pallas per-hop kernels
+    (static band hints engage the compact causal grid), fwd and bwd."""
+    q, k, v = make_qkv(rng, hk=2)
+    ref = default_attention(q, k, v, causal=True)
+    out = ring_attn_global(
+        q, k, v, mesh=mesh, causal=True, striped=True, bucket_size=8,
+        impl="pallas", counter_rotate=True,
+    )
+    np.testing.assert_allclose(out, ref, atol=ATOL)
+
+    g_ref = jax.grad(
+        lambda *a: (default_attention(*a, causal=True) ** 2).sum(), (0, 1, 2)
+    )(q, k, v)
+    g_out = jax.grad(
+        lambda *a: (
+            ring_attn_global(
+                *a, mesh=mesh, causal=True, striped=True, bucket_size=8,
+                impl="pallas", counter_rotate=True,
+            )
+            ** 2
+        ).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=GRAD_ATOL, err_msg=f"d{name}")
+
+
+# ----------------------------------------------------------------------
+# int8 hop compression: forward KV hops ship as per-token absmax int8
+# values + bitcast f32 scales in one payload; quantized once at ring
+# entry, exact-dtype residuals (and f32 accumulators) in backward.
+# ----------------------------------------------------------------------
+
+# Tolerance pins for int8-compressed hops vs f32 hops on unit-variance
+# inputs: ONE symmetric per-(head, token) absmax quantization costs
+# ~0.4% RMS on the kv values, which bounds the output error at ~2.5e-2
+# regardless of ring size — hops are lossless moves of the quantized
+# payload.  Grads recompute scores from the exact residual (k, v) but
+# reuse the quantized forward's (out, lse), so their error is that
+# forward error propagated through the quadratic test loss: measured
+# <= 1% in L2 (the meaningful number) with a <= 0.11 elementwise tail
+# on grad entries of O(10).  A regression past these pins means a
+# second quantization (or a lossy hop) crept into the schedule.
+INT8_FWD_TOL = 2.5e-2
+INT8_GRAD_REL_L2 = 1.5e-2
+INT8_GRAD_MAX_ABS = 0.15
+
+
+def test_ring_hop_compression_validation(rng, mesh):
+    q, k, v = make_qkv(rng)
+    with pytest.raises(ValueError, match="hop_compression"):
+        ring_attn_global(
+            q, k, v, mesh=mesh, causal=True, hop_compression="fp4"
+        )
+
+
+def _int8_fuzz_fns(mesh, counter, hk):
+    """Built ONCE per config so repeated seeds hit jax's trace cache:
+    (fwd_exact, fwd_int8, grad_exact, grad_int8) over global arrays."""
+    def build(compressed):
+        def fn(q, k, v):
+            return ring_flash_attention(
+                q, k, v, None, "seq", causal=True, bucket_size=8,
+                counter_rotate=counter,
+                hop_compression="int8" if compressed else None,
+            )
+        qspec = P("data", None, "seq", None)
+        fwd = shard_map(
+            fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec
+        )
+        grad = jax.grad(lambda *a: (fwd(*a) ** 2).sum(), (0, 1, 2))
+        return fwd, grad
+
+    fe, ge = build(False)
+    fc, gc = build(True)
+    return fe, fc, ge, gc
+
+
+def _assert_int8_grad_close(g_comp, g_exact, tag):
+    for a, b, name in zip(g_comp, g_exact, "qkv"):
+        rel = float(np.linalg.norm(a - b) / np.linalg.norm(b))
+        assert rel <= INT8_GRAD_REL_L2, (
+            f"d{name} {tag}: relative L2 {rel:.4f} > {INT8_GRAD_REL_L2}"
+        )
+        worst = float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        assert worst <= INT8_GRAD_MAX_ABS, (
+            f"d{name} {tag}: max abs {worst:.4f} > {INT8_GRAD_MAX_ABS}"
+        )
+
+
+def test_ring_int8_hop_parity_fuzz(mesh):
+    """Fuzz: int8-compressed hops vs f32 hops across random draws, fwd
+    AND grads, pinned tolerances.  Fast tier runs the hardest config —
+    counter-rotated GQA (compression composing with the Q-pack schedule
+    AND the group-summed dk/dv) — with compiled-fn reuse across seeds;
+    the full {uni,counter} x {mha,gqa} sweep is the slow-tier test
+    below.  The f32 (acc, m, l) accumulator contract the compression
+    relies on is machine-checked right here via
+    audit_accumulator_dtypes."""
+    fe, fc, ge, gc = _int8_fuzz_fns(mesh, counter=True, hk=2)
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        q, k, v = make_qkv(rng, hk=2)
+        np.testing.assert_allclose(
+            fc(q, k, v), fe(q, k, v), atol=INT8_FWD_TOL,
+            err_msg=f"fwd seed={seed}",
+        )
+        if seed == 0:  # grads: one seed in the fast tier
+            _assert_int8_grad_close(
+                gc(q, k, v), ge(q, k, v), f"seed={seed}"
+            )
+
+    from ring_attention_tpu.analysis.recompile import audit_accumulator_dtypes
+
+    assert audit_accumulator_dtypes() == []
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("counter", [False, True], ids=["uni", "counter"])
+@pytest.mark.parametrize("hk", [4, 2], ids=["mha", "gqa"])
+def test_ring_int8_hop_parity_fuzz_exhaustive(mesh, counter, hk):
+    """The full config sweep with grads at every seed."""
+    fe, fc, ge, gc = _int8_fuzz_fns(mesh, counter, hk)
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        q, k, v = make_qkv(rng, hk=hk)
+        np.testing.assert_allclose(
+            fc(q, k, v), fe(q, k, v), atol=INT8_FWD_TOL,
+            err_msg=f"fwd seed={seed}",
+        )
+        _assert_int8_grad_close(gc(q, k, v), ge(q, k, v), f"seed={seed}")
+
+
+def test_ring_int8_hop_packed_segments(rng, mesh):
+    """Compressed hops compose with packed segment ids (the ids ppermute
+    uncompressed alongside the int8 KV handle)."""
+    q, k, v = make_qkv(rng)
+    n = q.shape[2]
+    ids = np.zeros(n, np.int32)
+    ids[64:] = 1
+    seg = jnp.asarray(np.broadcast_to(ids, (2, n)).copy())
+
+    def run(compressed):
+        fn = partial(
+            ring_flash_attention, axis_name="seq", causal=True,
+            bucket_size=8,
+            hop_compression="int8" if compressed else None,
+        )
+        qspec = P("data", None, "seq", None)
+        return shard_map(
+            lambda q, k, v, s: fn(q, k, v, None, segment_ids=s),
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec, P("data", "seq")),
+            out_specs=qspec,
+        )(q, k, v, seg)
+
+    np.testing.assert_allclose(run(True), run(False), atol=INT8_FWD_TOL)
+
+
+# ----------------------------------------------------------------------
+# Rotation-elision pins: size-1 axes and None payloads never ppermute
+# ----------------------------------------------------------------------
+
+
+def _ring_ppermute_count(mesh, with_seg=False, **kw):
+    """Traced ppermute count (scan-multiplied) of one forward call."""
+    from ring_attention_tpu.analysis.contracts import jaxpr_collectives
+
+    ring = mesh.shape["seq"]
+    b = mesh.shape["data"]
+    n = 16 * ring
+    q = jnp.zeros((b, 4, n, 8), jnp.float32)
+    seg = jnp.zeros((b, n), jnp.int32) if with_seg else None
+
+    def fn(q, k, v, s):
+        return ring_flash_attention(
+            q, k, v, None, "seq", causal=True, bucket_size=8,
+            segment_ids=s, **kw,
+        )
+
+    qspec = P("data", None, "seq", None)
+    sspec = P("data", "seq") if with_seg else P()
+    sharded = shard_map(
+        fn, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
+        out_specs=qspec,
+    )
+    jc = jaxpr_collectives(jax.make_jaxpr(sharded)(q, q, q, seg))
+    return jc.counts.get("ppermute", 0)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [{}, {"bidirectional": True}, {"counter_rotate": True},
+     {"counter_rotate": True, "hop_compression": "int8"}],
+    ids=["uni", "bidi", "counter", "counter_int8"],
+)
+def test_ring_size1_axis_elides_every_rotation(kw):
+    """A size-1 seq axis (degenerate hybrid factorings) must trace ZERO
+    ppermutes in every stream scheme — identity rotations are real
+    collectives on some backends, so they are elided at trace time."""
+    mesh = create_mesh(ring_size=1, data_size=8)
+    assert _ring_ppermute_count(mesh, **kw) == 0
+
+
+def test_ring_none_payloads_never_rotate(mesh):
+    """kv_mask=None / segment_ids=None must not enter the rotation state:
+    an unpacked, unmasked hop ppermutes exactly its KV handle (packed
+    calls add one segment-id stream; the counter schedule splits the same
+    totals across its Q and KV streams)."""
+    base = _ring_ppermute_count(mesh)
+    packed = _ring_ppermute_count(mesh, with_seg=True)
+    assert base == 8  # 8 KV rotations (scan-traced), nothing else
+    assert packed == 2 * base  # + one segment-id payload per rotation
+    ctr = _ring_ppermute_count(mesh, counter_rotate=True)
+    ctr_packed = _ring_ppermute_count(mesh, with_seg=True,
+                                      counter_rotate=True)
+    assert ctr_packed == 2 * ctr - 1  # ids ride both streams, not catch-up

@@ -373,6 +373,54 @@ def test_ring_comms_accounting_hybrid_factoring():
         )
 
 
+def test_ring_comms_accounting_compression_and_counter():
+    """PR 6 terms as numbers.  int8 hop compression: bytes/hop shrink
+    dtype_bytes * d / (d + 4)-fold — ~3.8x from f32 at d=64 (the "~4x"
+    acceptance pin), hop COUNTS untouched, backward bytes untouched (the
+    compressed forward payload never enters the backward ring).  Counter-
+    rotation: one extra forward collective (the out/lse catch-up), the
+    backward's resident-KV schedule repays it, and the busier forward
+    link direction carries about half the baseline's rotation traffic."""
+    base = ring_comms_accounting(
+        ring_size=8, seq_len=8192, kv_heads=8, dim_head=64, dtype_bytes=4
+    )
+    comp = ring_comms_accounting(
+        ring_size=8, seq_len=8192, kv_heads=8, dim_head=64, dtype_bytes=4,
+        hop_compression="int8",
+    )
+    # per-hop payload: values 1 byte + 4 bitcast f32 scale bytes per row
+    assert comp["hop_bytes"] == 2 * 8 * (8192 // 8) * (64 + 4)
+    ratio = base["hop_bytes"] / comp["hop_bytes"]
+    assert ratio == pytest.approx(4 * 64 / (64 + 4))  # ~3.76x from f32
+    assert 3.5 < ratio < 4.0
+    assert comp["ring_hops"] == base["ring_hops"]
+    assert comp["fwd_collectives"] == base["fwd_collectives"]
+    # backward recirculates exact (k, v) + f32 (dk, dv): unchanged
+    assert (comp["ring_bytes_per_step_bwd"]
+            == base["ring_bytes_per_step_bwd"])
+
+    ctr = ring_comms_accounting(
+        ring_size=8, seq_len=8192, kv_heads=8, dim_head=64, dtype_bytes=4,
+        counter_rotate=True,
+    )
+    assert ctr["counter_rotate"] is True
+    # fwd: 7 rotations + the out/lse catch-up; baseline: 7
+    assert ctr["fwd_collectives"] == 8 and base["fwd_collectives"] == 7
+    # bwd: the q-side pack's 8 collectives vs the baseline's 2*8 - 1
+    assert ctr["bwd_collectives"] == 8 and base["bwd_collectives"] == 15
+    assert (ctr["fwd_collectives"] + ctr["bwd_collectives"]
+            < base["fwd_collectives"] + base["bwd_collectives"])
+    # full-duplex split: the busier direction carries well under the
+    # baseline's single-direction total
+    assert ctr["fwd_link_direction_bytes"] < base["fwd_link_direction_bytes"]
+    assert ctr["q_pack_bytes"] == 4 * 1 * 8 * (8192 // 8) * (2 * 64 + 2)
+    with pytest.raises(ValueError, match="hop_compression"):
+        ring_comms_accounting(
+            ring_size=8, seq_len=8192, kv_heads=8, dim_head=64,
+            hop_compression="fp4",
+        )
+
+
 def test_attention_logit_summaries_match_dense_oracle(rng):
     q = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)

@@ -126,6 +126,20 @@ def test_check_contracts_flags_parse():
         assert flag in proc.stdout, f"{flag} missing from --help"
 
 
+def test_check_contracts_knows_counter_variants():
+    """The counter-rotation / int8-compression strategies are enumerable
+    by name: an unknown strategy's error message lists every CONTRACTS
+    key, so this pins the rows' existence without compiling anything
+    (the full run lives in tests/test_analysis.py)."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--strategy", "nonesuch"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode != 0
+    for name in ("counter", "ring_compressed", "counter_compressed"):
+        assert name in proc.stderr, f"{name} missing from strategy listing"
+
+
 def test_check_contracts_mesh_mismatch_is_a_diagnostic():
     """A --mesh that fits none of the requested strategies must exit with
     a one-line diagnostic, not a traceback (hybrid needs a factored
