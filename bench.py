@@ -94,15 +94,23 @@ def _timed(chained_fn, args, iters):
 
 
 def _cost_fields(chained, args, secs_per_iter, iters):
-    """Best-effort XLA cost analysis of the timed executable: the
-    compiler-counted FLOPs/bytes next to the analytic formula, plus the
+    """Best-effort XLA cost + memory analysis of the timed executable:
+    the compiler-counted FLOPs/bytes next to the analytic formula, the
     achieved HBM bandwidth (``bytes accessed`` over the measured wall
-    time).  The lowering hits the jit cache, so this re-lower is cheap;
-    any failure returns ``{}`` — diagnostics never fail a measurement."""
+    time), and the compiled peak-memory accounting (``temp_bytes`` is the
+    scratch high-water mark the chunking/remat knobs shrink — the 1M
+    claim as a number, not prose).  The lowering hits the jit cache, so
+    this re-lower is cheap; any failure returns ``{}`` — diagnostics
+    never fail a measurement."""
     try:
-        from ring_attention_tpu.utils.telemetry import compiled_cost
+        from ring_attention_tpu.utils.telemetry import (
+            compiled_cost,
+            compiled_memory,
+        )
 
-        cost = compiled_cost(chained.lower(*args).compile())
+        exe = chained.lower(*args).compile()
+        cost = compiled_cost(exe)
+        mem = compiled_memory(exe)
     except Exception:  # noqa: BLE001
         return {}
     out = {}
@@ -114,6 +122,10 @@ def _cost_fields(chained, args, secs_per_iter, iters):
         out["hbm_gbps"] = round(
             cost["bytes_accessed"] / (secs_per_iter * iters) / 1e9, 1
         )
+    for key in ("temp_bytes", "argument_bytes", "output_bytes",
+                "host_temp_bytes", "host_argument_bytes"):
+        if key in mem:
+            out[key] = mem[key]
     return out
 
 
@@ -153,6 +165,107 @@ def _fingerprint_worker() -> None:
     print(json.dumps(collective_fingerprint()))
 
 
+def _train1m_mem_worker(extra: dict) -> None:
+    """CPU-provable half of the ``train1m`` phase: the memory claim.
+
+    Compiles the SAME train-step program twice at a proof shape — once
+    with the memory-axis knobs on (blockwise FFN + chunked CE +
+    ``nothing_saveable`` remat), once dense — and reports the compiler's
+    own peak-scratch accounting (``memory_analysis`` temp bytes) for
+    both: the acceptance relation is *chunked strictly below dense at
+    equal shape*.  Rides the forced-CPU pre-probe slot like the
+    fingerprint worker, so the number lands even on wedged-TPU rounds
+    (the backend-independent program structure is what the knobs change;
+    hardware tokens/sec comes from the post-probe timed phase).  Also
+    emits the analytic peak-HBM estimate of the full 2^20-token target
+    config (``telemetry.train_memory_estimate``) next to a v5e chip's
+    16 GB so the "1M fits" claim is checkable arithmetic.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.utils import enable_compile_cache
+    from ring_attention_tpu.utils.telemetry import (
+        compiled_memory,
+        train_memory_estimate,
+    )
+
+    enable_compile_cache()
+    target_seq = int(extra.get("target_seq", 1 << 20))
+    proof_seq = int(extra.get("proof_seq", 8192))
+    ff_chunk = int(extra.get("ff_chunk", 512))
+    loss_chunk = int(extra.get("loss_chunk", 512))
+    vocab = int(extra.get("vocab", 256))
+
+    from ring_attention_tpu.models import RingTransformer
+
+    def proof_model(chunk: bool):
+        # the train worker's dims, but bucket 512 instead of 2048: the
+        # relation under proof is the FFN term, and at bucket 2048 the
+        # attention recompute's tile scratch (h x bucket^2 f32) swamps it
+        # with scheduling noise at CPU-compilable sequence lengths
+        return RingTransformer(
+            num_tokens=vocab, dim=512, depth=2, causal=True, heads=HEADS,
+            dim_head=DIM_HEAD, bucket_size=min(512, proof_seq), rotary=True,
+            remat=True, remat_policy="nothing_saveable",
+            ff_chunk_size=ff_chunk if chunk else None,
+            loss_chunk_size=loss_chunk if chunk else None,
+            dtype=jnp.bfloat16,
+        )
+
+    chunked, dense = proof_model(True), proof_model(False)
+    params = chunked.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 129), jnp.int32),
+        return_loss=True,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, proof_seq + 1), 0, vocab, jnp.int32
+    )
+
+    def temp_bytes(model):
+        fn = jax.jit(jax.value_and_grad(
+            lambda p, t: model.apply(p, t, return_loss=True)
+        ))
+        return compiled_memory(fn.lower(params, tokens).compile())
+
+    mem_c = temp_bytes(chunked)
+    mem_d = temp_bytes(dense)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    # the estimate describes the TARGET (phase 7) configuration — its
+    # chunk sizes are emitted alongside so the arithmetic is checkable
+    # against exactly the config the row claims to describe
+    target_ff = int(extra.get("target_ff_chunk", 2048))
+    target_loss = int(extra.get("target_loss_chunk", 2048))
+    est_kw = dict(
+        seq_len=target_seq, dim=512, depth=2, heads=HEADS, vocab=vocab,
+        n_params=n_params, dtype_bytes=2, remat_policy="save_attn",
+    )
+    est_chunked = train_memory_estimate(
+        ff_chunk_size=target_ff, loss_chunk_size=target_loss, **est_kw
+    )
+    est_dense = train_memory_estimate(**est_kw)
+    tc, td = mem_c.get("temp_bytes"), mem_d.get("temp_bytes")
+    print(json.dumps({
+        "target_seq": target_seq,
+        "target_ff_chunk": target_ff,
+        "target_loss_chunk": target_loss,
+        "peak_hbm_estimate_gb": est_chunked["peak_hbm_gb"],
+        "peak_hbm_dense_estimate_gb": est_dense["peak_hbm_gb"],
+        "proof_seq": proof_seq,
+        "proof_ff_chunk": ff_chunk,
+        "proof_loss_chunk": loss_chunk,
+        "temp_bytes_chunked": tc,
+        "temp_bytes_dense": td,
+        "chunked_below_dense": (
+            tc is not None and td is not None and tc < td
+        ),
+        "temp_ratio": (
+            round(td / tc, 2) if tc and td else None
+        ),
+    }))
+
+
 def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     """Runs one timed measurement and prints its own JSON line.
 
@@ -169,7 +282,8 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     if mode == "train":
         _train_worker(impl, seq_len, extra.get("remat_policy"),
                       vocab=extra.get("vocab", 256),
-                      loss_chunk_size=extra.get("loss_chunk_size"))
+                      loss_chunk_size=extra.get("loss_chunk_size"),
+                      ff_chunk_size=extra.get("ff_chunk_size"))
         return
     if mode == "hops":
         _hops_worker(seq_len, int(extra.get("ring", 4)))
@@ -648,7 +762,8 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
 
 
 def _bench_transformer(impl: str, vocab: int, remat_policy: str | None,
-                       loss_chunk_size: int | None = None):
+                       loss_chunk_size: int | None = None,
+                       ff_chunk_size: int | None = None):
     """The ONE benchmark RingTransformer config + its init, shared by the
     train and packed workers so their tokens/sec stay comparable (same
     dims, remat, dtype; params are seq-independent so init runs on a
@@ -671,6 +786,7 @@ def _bench_transformer(impl: str, vocab: int, remat_policy: str | None,
         remat=True,
         remat_policy=remat_policy,
         loss_chunk_size=loss_chunk_size,
+        ff_chunk_size=ff_chunk_size,
         dtype=jnp.bfloat16,
     )
     init_tokens = jnp.zeros((1, 129), jnp.int32)
@@ -767,7 +883,8 @@ def _packed_worker(impl: str, seq_len: int, extra: dict) -> None:
 
 def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
                   vocab: int = 256,
-                  loss_chunk_size: int | None = None) -> None:
+                  loss_chunk_size: int | None = None,
+                  ff_chunk_size: int | None = None) -> None:
     """Full train step (fwd+bwd+adam) tokens/sec on one chip.
 
     ``remat_policy="save_attn"`` saves each layer's flash output + lse so
@@ -775,14 +892,16 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
     weak #1: the elective recompute cost the r2 headline ~2 s/step).
     ``vocab``/``loss_chunk_size`` measure the realistic-vocabulary
     configuration: at vocab 50257 the full-logits CE cannot fit a chip at
-    262k tokens, so the chunked loss is what makes the shape trainable."""
+    262k tokens, so the chunked loss is what makes the shape trainable.
+    ``ff_chunk_size`` adds the blockwise feedforward — with it, the
+    train1m phase's 2^20-token step fits one chip (docs/memory.md)."""
     import jax
     import jax.numpy as jnp
     import optax
 
     dev, peak = _device_peak()
     model, params = _bench_transformer(impl, vocab, remat_policy,
-                                       loss_chunk_size)
+                                       loss_chunk_size, ff_chunk_size)
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
 
@@ -841,6 +960,8 @@ def _train_worker(impl: str, seq_len: int, remat_policy: str | None,
                 "train_vocab": vocab,
                 **({"train_loss_chunk_size": loss_chunk_size}
                    if loss_chunk_size else {}),
+                **({"train_ff_chunk_size": ff_chunk_size}
+                   if ff_chunk_size else {}),
                 "train_ms_per_step": round(secs * 1e3, 2),
                 "train_compile_s": round(compile_s, 1),
                 "train_loss": round(float(loss), 4),
@@ -1068,6 +1189,19 @@ def main() -> None:
         result["collective_fingerprint"] = fp
     else:
         result["collective_fingerprint"] = {"error": (fp_err or "failed")[-200:]}
+
+    # phase 0c — train1m memory proof (CPU-only, pre-probe like the
+    # fingerprint): chunked-vs-dense compiled peak temp bytes at equal
+    # shape + the analytic 2^20-token peak-HBM estimate, so the
+    # memory-axis claim is a number in BENCH output even on wedged rounds
+    mm, mm_err = _run_attempt(
+        "cpu", 0, "train1m_mem",
+        float(os.environ.get("BENCH_MEM_BUDGET_S", 900)),
+    )
+    if mm is not None:
+        result["train1m_memory"] = mm
+    else:
+        result["train1m_memory"] = {"error": (mm_err or "failed")[-200:]}
 
     # probe once, reuse across phases AND back-to-back invocations: the
     # verdict is cached on disk with a TTL (see _cached_probe) so a wedged
@@ -1332,6 +1466,29 @@ def main() -> None:
         else:
             log.append(err)
 
+    # phase 7 — train1m (ROADMAP item 4): the 2^20-token train step on one
+    # chip — blockwise FFN + chunked CE + save_attn, the configuration the
+    # memory phase (0c) proves fits.  tokens/sec plus the compiled
+    # peak-memory fields land next to counter262k.
+    if best is not None and budget_left(1800):
+        payload, err = _run_attempt(
+            best[0], 1 << 20, "train",
+            min(1800, deadline - time.monotonic()),
+            {"remat_policy": "save_attn", "loss_chunk_size": 2048,
+             "ff_chunk_size": 2048},
+        )
+        if payload is not None:
+            result["train1m"] = payload["tokens_per_sec"]
+            result["train1m_tokens_per_sec"] = payload["tokens_per_sec"]
+            result["train1m_ms_per_step"] = payload["train_ms_per_step"]
+            result["train1m_compile_s"] = payload["train_compile_s"]
+            for key in ("temp_bytes", "argument_bytes"):
+                if key in payload:
+                    result[f"train1m_{key}"] = payload[key]
+            log.append(f"train1m:{best[0]}@{1 << 20}: ok")
+        else:
+            log.append(err)
+
     # keep the attempt trail even on success so a fallback-sized result is
     # never mistaken for a clean north-star run round-over-round
     result["attempts"] = " | ".join(log)[-900:]
@@ -1348,6 +1505,9 @@ if __name__ == "__main__":
         if mode == "fingerprint":
             # env setup must precede the first jax import (see the worker)
             _fingerprint_worker()
+        elif mode == "train1m_mem":
+            # likewise CPU-forced before the first jax import
+            _train1m_mem_worker(extra)
         else:
             _worker(sys.argv[2], int(sys.argv[3]), mode, extra)
     else:

@@ -44,10 +44,29 @@ def main() -> None:
                     help="gradient-accumulation microbatches per update")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize blocks (activation memory savings)")
+    ap.add_argument("--remat-policy", default=None,
+                    help="what each rematted block may KEEP instead of "
+                         "recomputing (implies --remat): nothing_saveable, "
+                         "everything_saveable, checkpoint_dots, "
+                         "checkpoint_dots_no_batch, save_attn, "
+                         "save_ffn_inputs, save_attn_and_ffn_inputs, "
+                         "offload_attn — the policy table is "
+                         "docs/memory.md; validation lists the registry")
+    ap.add_argument("--ff-chunk-size", type=int, default=None,
+                    help="blockwise feedforward: run each FFN as a "
+                         "rematted scan over sequence chunks of this size "
+                         "so the (seq, mult*dim) intermediate never exists "
+                         "at full extent (Ring Attention's blockwise FFN; "
+                         "docs/memory.md)")
     ap.add_argument("--loss-chunk-size", type=int, default=None,
                     help="chunked cross-entropy: at most (batch, chunk, "
                          "vocab) logits materialize — required at real LM "
                          "vocabularies with long sequences")
+    ap.add_argument("--offload-opt-state", action="store_true",
+                    help="host offload of the optimizer state (Adam "
+                         "moments leave HBM between steps); a no-op on "
+                         "backends without an addressable host memory "
+                         "space, e.g. jax 0.4.x CPU (docs/memory.md)")
     ap.add_argument("--use-pallas", action="store_true",
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     ap.add_argument("--bidirectional", action="store_true",
@@ -162,7 +181,9 @@ def main() -> None:
         ring_bidirectional=args.bidirectional,
         ring_counter_rotate=args.counter_rotate,
         ring_hop_compression=args.hop_compression,
-        remat=args.remat,
+        remat=args.remat or args.remat_policy is not None,
+        remat_policy=args.remat_policy,
+        ff_chunk_size=args.ff_chunk_size,
         loss_chunk_size=args.loss_chunk_size,
         dtype=jnp.bfloat16 if args.bf16 else None,
     )
@@ -205,6 +226,11 @@ def main() -> None:
     params = model.init(jax.random.PRNGKey(0), tokens)
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)
+    if args.offload_opt_state:
+        # seed the loop host-side; the step keeps it there (utils/train.py)
+        from ring_attention_tpu.utils import compat
+
+        opt_state = compat.host_device_put(opt_state, mesh)
 
     if args.pack:
         def loss_fn(p, t, s):
@@ -228,6 +254,8 @@ def main() -> None:
         clip_grad_norm=args.clip_grad_norm,
         jit_donate=True,
         collect_metrics=collect,
+        offload_opt_state=args.offload_opt_state,
+        offload_mesh=mesh,
     )
 
     # preemption-safe resume: atomic saves, keep-last-N, corrupt-checkpoint
@@ -290,6 +318,20 @@ def main() -> None:
             )
         else:
             comms = {"ring_hops": 0, "ring_hops_per_step": 0, "hop_bytes": 0}
+        # compiled peak-memory accounting of the step that actually runs
+        # (telemetry.compiled_memory): AOT-compile once, log temp/argument
+        # bytes next to the analytic comms numbers, and drive the loop on
+        # the same executable — no second compile
+        try:
+            from ring_attention_tpu.utils.telemetry import compiled_memory
+
+            compiled_exe = train_step.lower(
+                params, opt_state, metrics, *batch
+            ).compile()
+            comms.update(compiled_memory(compiled_exe))
+            train_step = compiled_exe
+        except Exception:  # noqa: BLE001 — diagnostics never fail the run
+            pass
 
     timer = StepTimer(tokens_per_step=tokens.size)
     for step in range(start, args.steps):

@@ -25,6 +25,9 @@ from .recompile import (
     RetraceError,
     assert_compiles_once,
     audit_accumulator_dtypes,
+    audit_donation,
+    audit_host_offload,
+    audit_remat_residuals,
 )
 
 __all__ = [
@@ -33,6 +36,9 @@ __all__ = [
     "Violation",
     "assert_compiles_once",
     "audit_accumulator_dtypes",
+    "audit_donation",
+    "audit_host_offload",
+    "audit_remat_residuals",
     "lint_file",
     "lint_package",
     "lint_source",

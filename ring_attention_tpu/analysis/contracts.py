@@ -208,6 +208,21 @@ CONTRACTS: dict[str, dict[str, Any]] = {
             "fwdbwd": {"ppermute": "3 * passes", "all_to_all": "8"},
         },
     },
+    "blockwise_ffn": {
+        "description": "chunked feedforward (Ring Attention's blockwise "
+                       "FFN, arXiv 2310.01889): chunks split WITHIN each "
+                       "sequence shard, so the rematted scan adds ZERO "
+                       "collectives — forward has none at all, backward "
+                       "has exactly the dense FFN's two weight-grad "
+                       "all-reduces",
+        "impl": "xla",
+        "mesh": "plain",
+        "axes": {},
+        "hlo": {
+            "fwd": {},
+            "fwdbwd": {"all-reduce": "2"},
+        },
+    },
     "tree_decode": {
         "description": "tree-attention decode merge: pmax + two psums, "
                        "nothing touches the O(seq) cache shards",
@@ -552,7 +567,7 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
     ``(q, k, v)`` global arrays; tiny shapes — these programs exist to be
     compiled and inspected, not run."""
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.hybrid import hybrid_attention
     from ..parallel.mesh import (
@@ -642,6 +657,41 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
         in_specs = (rep, spec, spec)
         out_specs = rep
         args = (mk(heads, 1), mk(kv_heads), mk(kv_heads))
+    elif strategy == "blockwise_ffn":
+        # the one auto-sharded (GSPMD) row: the chunked FeedForward runs
+        # under the partitioner like the model path does, NOT inside
+        # shard_map — the contract pins what the partitioner inserts.
+        # fn(x, w_in, w_out) keeps build_entry's uniform 3-arg shape so
+        # _direction_fn's (0, 1, 2) grads produce the weight all-reduces.
+        import jax
+
+        from ..models.layers import FeedForward
+
+        world = dims["world"]
+        ff = FeedForward(
+            dim=dim_head, mult=4, chunk_size=max(seq // world // 2, 1),
+            seq_shards=world, mesh=mesh,
+        )
+        x = jnp.asarray(rng.standard_normal((b, seq, dim_head)), jnp.float32)
+        params = ff.init(jax.random.PRNGKey(0), x)
+        gamma = params["params"]["RMSNorm_0"]["gamma"]
+
+        def ffn(x, w_in, w_out):
+            p = {"params": {
+                "RMSNorm_0": {"gamma": gamma},
+                "Dense_0": {"kernel": w_in},
+                "Dense_1": {"kernel": w_out},
+            }}
+            return ff.apply(p, x)
+
+        x = jax.device_put(x, NamedSharding(
+            mesh, P(DATA_AXIS, seq_partition(mesh), None)
+        ))
+        return ffn, (
+            x,
+            params["params"]["Dense_0"]["kernel"],
+            params["params"]["Dense_1"]["kernel"],
+        ), dims
     else:
         raise KeyError(f"unknown strategy {strategy!r}; "
                        f"known: {sorted(CONTRACTS)}")
@@ -992,7 +1042,8 @@ def run_contract_suite(strategies=None, *, scan: bool = True,
 
 
 def collective_fingerprint(
-    strategies=("ring", "ulysses", "hybrid", "counter", "ring_compressed"),
+    strategies=("ring", "ulysses", "hybrid", "counter", "ring_compressed",
+                "blockwise_ffn"),
 ) -> dict:
     """Compact comms signature for the bench JSON: per-strategy forward
     collective counts from compiled HLO, so a perf trajectory catches a

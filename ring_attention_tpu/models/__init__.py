@@ -1,5 +1,13 @@
 from .attention import RingAttention
 from .layers import FeedForward, RMSNorm
+from .remat import REMAT_POLICIES, resolve_remat_policy
 from .transformer import RingTransformer
 
-__all__ = ["RingAttention", "FeedForward", "RMSNorm", "RingTransformer"]
+__all__ = [
+    "RingAttention",
+    "FeedForward",
+    "RMSNorm",
+    "RingTransformer",
+    "REMAT_POLICIES",
+    "resolve_remat_policy",
+]

@@ -46,6 +46,7 @@ from ..parallel.sharding import (
 from ..utils.validate import check_tokens_input
 from .attention import RingAttention
 from .layers import FeedForward, RMSNorm
+from .remat import REMAT_POLICIES, resolve_remat_policy
 
 
 def _position_nll(
@@ -117,19 +118,29 @@ class RingTransformer(nn.Module):
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
     # shard_map has no eager path)
     remat: bool = False
-    # remat refinement: "save_attn" additionally saves each layer's
-    # attention output + lse (the flash custom_vjp residuals, named in
-    # parallel/ring.py), so the backward skips re-running the O(n^2) ring
-    # scan — costing only (b, n, dim) + (b, h, n) saved activations per
-    # layer.  None = plain full-block remat.
-    remat_policy: str | None = None
+    # remat refinement: which intermediates each rematted block may KEEP
+    # instead of recomputing — a name from models/remat.py REMAT_POLICIES
+    # ("save_attn" saves flash_out/flash_lse so the backward skips the
+    # O(n^2) ring scan; "save_ffn_inputs" elides the FFN norm recompute;
+    # "offload_attn" parks the attn residuals in host memory; see
+    # docs/memory.md for the full table).  A tuple selects per layer
+    # (mirroring max_lookback_seq_len); None = plain full-block remat.
+    remat_policy: str | tuple[str | None, ...] | None = None
+    # blockwise feedforward (Ring Attention §3, arXiv 2310.01889): run each
+    # FeedForward as a rematted scan over sequence chunks of this size so
+    # the (seq, mult*dim) intermediate never exists at full sequence
+    # extent — the memory-axis twin of loss_chunk_size (docs/memory.md).
+    # Chunks split WITHIN each sequence shard, so the scan adds zero
+    # collectives (pinned: analysis/contracts.py "blockwise_ffn" row).
+    # None = dense FFN; shard lengths that don't divide are padded.
+    ff_chunk_size: int | None = None
     # chunked cross-entropy: compute the loss as a rematted lax.scan over
     # sequence chunks of this size, so at most (b, chunk, vocab) logits
-    # ever materialize.  At a real LM vocab the full logits tensor is the
-    # long-context memory wall — (1, 262144, 50257) f32 is ~53 GB, more
-    # than attention remat saves — and neither materializing it nor the
-    # reference (which does, ref ring_attention.py:659-673) can train
-    # those shapes.  None = single dense logits+CE (fine for small vocab)
+    # ever materialize — at a real LM vocab the full logits tensor is the
+    # long-context memory wall.  None = single dense logits+CE (fine for
+    # small vocab).  The full memory story (why, when, and how this
+    # composes with ff_chunk_size / remat_policy / offload) lives in
+    # docs/memory.md.
     loss_chunk_size: int | None = None
     dtype: jnp.dtype | None = None
 
@@ -144,24 +155,31 @@ class RingTransformer(nn.Module):
                 f"chunking; 0 would silently disable it, a negative value "
                 f"breaks padding)"
             )
+        if self.ff_chunk_size is not None and self.ff_chunk_size <= 0:
+            raise ValueError(
+                f"RingTransformer: ff_chunk_size must be None or a positive "
+                f"int, got {self.ff_chunk_size!r} (None disables the "
+                f"blockwise feedforward; any positive size works — shard "
+                f"lengths that don't divide are padded)"
+            )
+        policies = self._remat_policies()
         self.embed = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype)
         # flax-lifted remat (NOT raw jax.checkpoint: param creation during
         # init is a side effect that would leak tracers out of the
-        # checkpointed trace)
+        # checkpointed trace); one lifted class per layer so the policy is
+        # per-layer selectable
         if self.remat:
-            assert self.remat_policy in (None, "save_attn"), self.remat_policy
-            policy = (
-                jax.checkpoint_policies.save_only_these_names(
-                    "flash_out", "flash_lse"
-                )
-                if self.remat_policy == "save_attn"
-                else None
-            )
-            attn_cls = nn.remat(RingAttention, policy=policy)
-            ff_cls = nn.remat(FeedForward)
+            attn_classes = [
+                nn.remat(RingAttention, policy=resolve_remat_policy(p))
+                for p in policies
+            ]
+            ff_classes = [
+                nn.remat(FeedForward, policy=resolve_remat_policy(p))
+                for p in policies
+            ]
         else:
-            attn_cls = RingAttention
-            ff_cls = FeedForward
+            attn_classes = [RingAttention] * self.depth
+            ff_classes = [FeedForward] * self.depth
         self.attn_layers = [
             attn_cls(
                 dim=self.dim,
@@ -189,11 +207,16 @@ class RingTransformer(nn.Module):
                 ring_hop_compression=self.ring_hop_compression,
                 dtype=self.dtype,
             )
-            for lookback in self._lookbacks()
+            for attn_cls, lookback in zip(attn_classes, self._lookbacks())
         ]
         self.ff_layers = [
-            ff_cls(self.dim, self.ff_mult, dtype=self.dtype)
-            for _ in range(self.depth)
+            ff_cls(
+                self.dim, self.ff_mult, dtype=self.dtype,
+                chunk_size=self.ff_chunk_size,
+                seq_shards=self._ring_size(),
+                mesh=self.mesh if self.auto_shard else None,
+            )
+            for ff_cls in ff_classes
         ]
         self.final_norm = RMSNorm(self.dim)
         self.to_logits = nn.Dense(self.num_tokens, use_bias=False, dtype=self.dtype)
@@ -224,6 +247,28 @@ class RingTransformer(nn.Module):
             lb = (lb,) * self.depth
         assert len(lb) == self.depth
         return lb
+
+    def _remat_policies(self) -> tuple[str | None, ...]:
+        """Per-layer remat-policy names, validated against the registry
+        (models/remat.py) — a ValueError here lists every valid name, where
+        the old ``assert`` vanished under ``python -O``."""
+        p = self.remat_policy
+        if not isinstance(p, tuple):
+            p = (p,) * self.depth
+        if len(p) != self.depth:
+            raise ValueError(
+                f"RingTransformer: remat_policy tuple has {len(p)} entries "
+                f"for depth {self.depth} (one policy name per layer, or a "
+                f"single name for all layers)"
+            )
+        for name in p:
+            if name is not None and name not in REMAT_POLICIES:
+                raise ValueError(
+                    f"RingTransformer: unknown remat_policy {name!r}; valid "
+                    f"policies: {', '.join(sorted(REMAT_POLICIES))} (or "
+                    f"None for plain full-block remat)"
+                )
+        return p
 
     def __call__(
         self,

@@ -97,6 +97,95 @@ def tpu_compiler_params(**kwargs):
     return cls(**kwargs)
 
 
+def host_memory_kind() -> str | None:
+    """The device-addressable host memory space ("pinned_host" on TPU/GPU
+    builds with offload support), or None when the backend exposes none.
+
+    CPU backends report only "unpinned_host" — which IS host memory
+    already, so "offloading" there is meaningless and callers correctly
+    degrade to the identity.  Every probe failure (old jax without
+    ``addressable_memories``, exotic backends) reads as "no host space":
+    offload is an optimization and must never be the thing that crashes.
+    """
+    try:
+        kinds = {
+            m.kind
+            for d in jax.local_devices()
+            for m in d.addressable_memories()
+        }
+    except Exception:  # noqa: BLE001 — any probe failure means "unsupported"
+        return None
+    return "pinned_host" if "pinned_host" in kinds else None
+
+
+def host_sharding(sharding):
+    """``sharding`` moved into the host memory space, or None when this
+    backend has no host space / the sharding cannot express memory kinds
+    (old jax).  Callers treat None as "keep the buffer where it is"."""
+    kind = host_memory_kind()
+    if kind is None:
+        return None
+    try:
+        return sharding.with_memory_kind(kind)
+    except (AttributeError, ValueError):
+        return None
+
+
+def host_device_put(tree, mesh=None):
+    """Move every array leaf of ``tree`` into host memory, PRESERVING its
+    sharding; the identity when the backend has no host memory space.
+
+    This is the jax-0.4.x-safe offload primitive: ``jax.device_put`` onto
+    a memory-kind target is the documented in-graph transfer
+    (``with_sharding_constraint`` did not learn memory kinds until later
+    releases).  Placement keeps each leaf's partitioning — a ZeRO-1
+    sharded optimizer state stays sharded on host, never silently
+    re-replicated N-x:
+
+    - concrete leaves (seeding the loop outside jit) move via their own
+      ``sharding.with_memory_kind``;
+    - traced leaves (inside the step) move via ``TransferToMemoryKind``,
+      which changes only the memory space and lets the partitioner keep
+      the layout it chose; ``mesh`` is only the replicated fallback for
+      jax builds without it.
+
+    Used by ``make_train_step(offload_opt_state=True)`` for the Adam
+    moments — the next HBM cliff after activations (docs/memory.md).
+    """
+    from jax.sharding import (
+        NamedSharding,
+        PartitionSpec,
+        SingleDeviceSharding,
+    )
+
+    kind = host_memory_kind()
+    if kind is None:
+        return tree
+
+    def fallback_sharding():
+        if mesh is not None:
+            return NamedSharding(mesh, PartitionSpec(), memory_kind=kind)
+        return SingleDeviceSharding(jax.devices()[0], memory_kind=kind)
+
+    def place(x):
+        if isinstance(x, jax.core.Tracer):
+            try:  # private in 0.4.x (public jax.sharding export came later)
+                from jax._src.sharding_impls import TransferToMemoryKind
+
+                return jax.device_put(x, TransferToMemoryKind(kind))
+            except Exception:  # noqa: BLE001 — degrade, never crash
+                return jax.device_put(x, fallback_sharding())
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None:
+            try:
+                return jax.device_put(x, sharding.with_memory_kind(kind))
+            except (AttributeError, ValueError):
+                pass
+        return jax.device_put(x, fallback_sharding())
+
+    return jax.tree.map(place, tree)
+
+
 def axis_size(axis_name):
     """``lax.axis_size`` (new) or the bound axis frame's size (old).
 

@@ -510,6 +510,109 @@ def compiled_cost(compiled: Any) -> dict[str, float]:
         return {}
 
 
+def compiled_memory(compiled: Any) -> dict[str, int]:
+    """Best-effort ``memory_analysis()`` of a compiled executable: the
+    compiler's own peak-memory accounting (``temp_bytes`` is the scratch
+    high-water mark — the number the blockwise-FFN/remat knobs exist to
+    shrink), as ``{"temp_bytes", "argument_bytes", "output_bytes",
+    "alias_bytes"(+host_* when a host memory space is in play)}``.  Empty
+    when the backend offers no analysis — never raises.  Works on the CPU
+    backend too, which is what lets bench.py's ``train1m`` phase prove the
+    chunked-FFN memory claim on a wedged-TPU round (docs/memory.md)."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {}
+        out: dict[str, int] = {}
+        for attr, key in (
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+        ):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[key] = int(v)
+        for attr, key in (
+            ("host_temp_size_in_bytes", "host_temp_bytes"),
+            ("host_argument_size_in_bytes", "host_argument_bytes"),
+            ("host_output_size_in_bytes", "host_output_bytes"),
+        ):
+            v = getattr(ma, attr, None)
+            if v:  # host figures are 0 unless offload is active
+                out[key] = int(v)
+        return out
+    except Exception:  # noqa: BLE001 — diagnostics must never fail a run
+        return {}
+
+
+def train_memory_estimate(
+    *,
+    seq_len: int,
+    dim: int,
+    depth: int,
+    heads: int,
+    vocab: int,
+    n_params: int,
+    batch: int = 1,
+    ff_mult: int = 4,
+    dtype_bytes: int = 2,
+    ff_chunk_size: int | None = None,
+    loss_chunk_size: int | None = None,
+    remat_policy: str | None = None,
+    offload_opt_state: bool = False,
+    seq_shards: int = 1,
+) -> dict[str, Any]:
+    """Analytic per-chip peak-HBM model of one rematted train step.
+
+    The measured truth is ``compiled_memory()`` of the actual executable;
+    this formula exists so bench.py can print an estimate for shapes it
+    did not compile (the 1M-token target on a wedged-TPU round) and so a
+    config can be sanity-checked against a chip's HBM before burning a
+    hardware window.  Terms (per chip, sequence split ``seq_shards``-ways):
+
+    - params: weights (model dtype) + Adam moments (2x f32) + f32 grads,
+      moments dropped from HBM when ``offload_opt_state``;
+    - saved per layer: the two rematted block inputs ``2*(b, n, dim)``,
+      plus the policy's keeps (``save_attn``: ``(b, n, dim)`` out +
+      f32 ``(b, h, n)`` lse; ``offload_attn`` keeps those on host);
+    - transient peak: the largest single recompute working set —
+      the FFN intermediate ``(b, n_or_chunk, mult*dim)`` (THE term
+      ``ff_chunk_size`` shrinks), the CE logits ``(b, n_or_chunk, vocab)``
+      f32 (``loss_chunk_size``), and the flash workspace (bucket-local,
+      negligible at these scales).
+    """
+    n = seq_len // max(seq_shards, 1)
+    b = batch
+    act = dtype_bytes
+
+    params_bytes = n_params * act + n_params * 4  # weights + f32 grads
+    opt_bytes = 0 if offload_opt_state else 2 * n_params * 4
+    saved = 2 * b * n * dim * act  # the two block inputs per layer
+    policy = remat_policy or "nothing_saveable"
+    if policy in ("save_attn", "save_attn_and_ffn_inputs"):
+        saved += b * n * dim * act + b * heads * n * 4  # flash_out + lse
+    if policy in ("save_ffn_inputs", "save_attn_and_ffn_inputs"):
+        saved += b * n * dim * act  # ffn_in
+    saved *= depth
+
+    ff_n = min(ff_chunk_size, n) if ff_chunk_size else n
+    ce_n = min(loss_chunk_size, n) if loss_chunk_size else n
+    transient = max(
+        b * ff_n * ff_mult * dim * act,  # FFN intermediate (+grad twin)
+        b * ce_n * vocab * 4,  # CE logits in f32
+    ) * 2  # forward value + its cotangent live together in backward
+
+    total = params_bytes + opt_bytes + saved + transient
+    return {
+        "peak_hbm_bytes": int(total),
+        "peak_hbm_gb": round(total / 2**30, 3),
+        "params_bytes": int(params_bytes + opt_bytes),
+        "saved_activation_bytes": int(saved),
+        "transient_bytes": int(transient),
+    }
+
+
 def ring_comms_accounting(
     *,
     ring_size: int,

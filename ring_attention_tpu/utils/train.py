@@ -58,6 +58,8 @@ def make_train_step(
     clip_grad_norm: float | None = None,
     jit_donate: bool = False,
     collect_metrics: bool = False,
+    offload_opt_state: bool = False,
+    offload_mesh: Mesh | None = None,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
@@ -94,6 +96,21 @@ def make_train_step(
       double-allocating — at long context the Adam moments are the next
       HBM cliff after activations.  Callers jitting by hand should pass
       ``donate_argnums=(0, 1)`` themselves.
+    - ``offload_opt_state=True`` — opt-in host offload of the optimizer
+      state (``docs/memory.md``): the updated state is transferred into
+      the backend's host memory space (``pinned_host``) inside the step,
+      so the Adam moments — 2 model-sized f32 buffers — stop occupying
+      HBM between steps.  Seed the loop by placing the initial state
+      there too: ``opt_state = compat.host_device_put(opt.init(params),
+      mesh)``.  Placement preserves each leaf's sharding (a ZeRO-1
+      sharded state stays sharded on host); ``offload_mesh`` only feeds
+      the replicated fallback on jax builds without
+      ``TransferToMemoryKind``.
+      On backends without an addressable host space (jax 0.4.x CPU) the
+      transfer is the identity and the step is unchanged — the
+      graceful-degradation contract every compat shim follows; the
+      placement is auditable via ``analysis.recompile.audit_host_offload``
+      and ``tools/check_contracts.py --memory``.
     - ``collect_metrics=True`` — the instrumented step
       (``utils/telemetry.py``): the signature becomes
       ``step(params, opt_state, metrics, *batch) ->
@@ -172,6 +189,16 @@ def make_train_step(
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss, gnorm
 
+    def place_opt(opt_state):
+        # host offload runs LAST in the step (after any skip-guard select)
+        # so the returned buffers actually land — and stay — in host
+        # memory; a no-op on backends without a host space
+        if not offload_opt_state:
+            return opt_state
+        from . import compat
+
+        return compat.host_device_put(opt_state, offload_mesh)
+
     def finish(step):
         if not jit_donate:
             return step
@@ -185,7 +212,7 @@ def make_train_step(
             new_params, new_opt_state, loss, _ = compute_update(
                 params, opt_state, *batch
             )
-            return new_params, new_opt_state, loss
+            return new_params, place_opt(new_opt_state), loss
 
         return finish(step)
 
@@ -221,7 +248,7 @@ def make_train_step(
                 step_ok=ok,
                 skipped=stats.skipped + jnp.where(ok, 0, 1).astype(jnp.int32),
             )
-            return params, opt_state, stats, loss
+            return params, place_opt(opt_state), stats, loss
 
         return finish(guarded_step)
 
@@ -248,7 +275,7 @@ def make_train_step(
             + (jnp.where(finite, zero, one) if skip_nonfinite else zero),
             nonfinite=metrics.nonfinite + jnp.where(finite, zero, one),
         )
-        return params, opt_state, metrics, loss
+        return params, place_opt(opt_state), metrics, loss
 
     return finish(metric_step)
 

@@ -114,15 +114,16 @@ def test_check_contracts_compiles():
 
 def test_check_contracts_flags_parse():
     """``check_contracts.py`` must keep its documented flag surface
-    (``--strategy/--mesh/--json``): argparse runs before any jax device
-    work, so this smoke needs no simulated mesh.  The full 20-contract
-    run lives in tests/test_analysis.py."""
+    (``--strategy/--mesh/--json/--memory``): argparse runs before any jax
+    device work, so this smoke needs no simulated mesh.  The full
+    contract run lives in tests/test_analysis.py; the memory-audit suite
+    in tests/test_memory.py."""
     proc = subprocess.run(
         [sys.executable, CHECK_CONTRACTS, "--help"],
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
-    for flag in ("--strategy", "--mesh", "--json", "--devices"):
+    for flag in ("--strategy", "--mesh", "--json", "--devices", "--memory"):
         assert flag in proc.stdout, f"{flag} missing from --help"
 
 

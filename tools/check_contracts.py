@@ -7,10 +7,18 @@ jaxpr structure against the declarative table in
 ``ring_attention_tpu/analysis/contracts.py`` — the machine-checked version
 of "exactly ring-1 ppermutes per forward".
 
+``--memory`` runs the memory-axis audit suite instead
+(``analysis/recompile.py``): f32 accumulator dtypes, remat-residual
+policy leaks on the chunked-FFN path (with a negative toy proving the
+audit is live), donation aliasing and host-offload placement of the
+composed train step, and the chunked-vs-dense compiled peak-temp-bytes
+relation — the machine-checked version of docs/memory.md's claims.
+
 Examples:
   python tools/check_contracts.py --strategy all
   python tools/check_contracts.py --strategy hybrid --mesh 1x2x4
   python tools/check_contracts.py --strategy ring --mesh 2x4 --json
+  python tools/check_contracts.py --memory
 
 Exit status 0 = every contract holds.  Runs anywhere (no TPU needed):
 ``--devices N`` simulated host devices, default 8.
@@ -62,6 +70,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--heads", type=int, default=8)
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON object instead of the table")
+    parser.add_argument("--memory", action="store_true",
+                        help="run the memory-axis audits (accumulator "
+                             "dtypes, remat-residual leaks, donation "
+                             "aliasing, host-offload placement, chunked-"
+                             "vs-dense peak temp bytes) instead of the "
+                             "collective contracts")
     args = parser.parse_args(argv)
 
     # must precede the first jax import
@@ -70,6 +84,29 @@ def main(argv: list[str] | None = None) -> int:
         + f" --xla_force_host_platform_device_count={args.devices}"
     )
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.memory:
+        from ring_attention_tpu.analysis.recompile import run_memory_suite
+
+        checks = run_memory_suite()
+        failed_names = [name for name, v in checks if v]
+        if args.json:
+            print(json.dumps({
+                "ok": not failed_names,
+                "checked": len(checks),
+                "checks": [
+                    {"name": name, "ok": not v, "violations": v}
+                    for name, v in checks
+                ],
+            }, indent=2))
+        else:
+            for name, v in checks:
+                print(f"{'ok  ' if not v else 'FAIL'} {name}")
+                for line in v:
+                    print(f"     {line}")
+            print(f"{len(checks) - len(failed_names)}/{len(checks)} "
+                  f"memory checks hold")
+        return 1 if failed_names else 0
 
     from ring_attention_tpu.analysis import contracts
 

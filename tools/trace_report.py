@@ -43,8 +43,8 @@ PREFERRED = [
     "mfu",
 ]
 
-# comms-accounting fields echoed as a static block (they do not vary per
-# step — one line each beats 5 columns of constants)
+# comms-accounting + compiled-memory fields echoed as a static block
+# (they do not vary per step — one line each beats 5 columns of constants)
 ACCOUNTING = [
     "ring_size",
     "ulysses_size",
@@ -56,6 +56,16 @@ ACCOUNTING = [
     "ring_bytes_per_step_bwd",
     "a2a_bytes_per_step",
     "hop_overlap_fraction",
+    # compiled peak-memory accounting of the train step (telemetry
+    # .compiled_memory — temp_bytes is the scratch high-water mark the
+    # ff_chunk_size / loss_chunk_size / remat-policy knobs shrink)
+    "temp_bytes",
+    "argument_bytes",
+    "output_bytes",
+    "alias_bytes",
+    "host_temp_bytes",
+    "host_argument_bytes",
+    "host_output_bytes",
 ]
 
 # stage buckets for the xprof table, keyed on the stable scope/kernel
