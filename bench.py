@@ -39,6 +39,48 @@ TARGET_SEQ = 262144
 HEADS = 8
 DIM_HEAD = 64
 
+
+def _load_repo_module(name: str, *relpath: str):
+    """Load a package module by FILE PATH, bypassing the package
+    ``__init__`` chain: this parent process must touch no jax code before
+    the subprocess-isolated device probe (a wedged tunnel can hang
+    jax-level work — the exact state the probe exists to detect).  Only
+    valid for the modules that are stdlib-only at module level by design
+    (resilience.py, telemetry.py, analysis/perfgate.py)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), *relpath),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclass field resolution
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_GATE_SCHEMA_CACHE: list[int] = []
+
+
+def _gate_schema() -> int:
+    """The perf-gate history schema version (``analysis/perfgate.py``),
+    stamped on every phase payload so ``tools/perf_gate.py``'s ingest can
+    version-check rounds.  Loaded by file path ONCE per process; returns
+    0 (unknown) if the module cannot load — a stamping failure must
+    never cost a bench round."""
+    if _GATE_SCHEMA_CACHE:
+        return _GATE_SCHEMA_CACHE[0]
+    try:
+        mod = _load_repo_module(
+            "_bench_perfgate", "ring_attention_tpu", "analysis",
+            "perfgate.py",
+        )
+        version = int(mod.GATE_SCHEMA_VERSION)
+    except Exception:  # noqa: BLE001
+        version = 0
+    _GATE_SCHEMA_CACHE.append(version)
+    return version
+
 # bf16 peak TFLOPs per chip by TPU generation (dense)
 PEAK_TFLOPS = {
     "v5 lite": 197.0,  # v5e
@@ -993,7 +1035,12 @@ def _run_attempt(impl: str, seq: int, mode: str, budget: float,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         if proc.returncode == 0:
-            return json.loads(proc.stdout.strip().splitlines()[-1]), None
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            if isinstance(payload, dict):
+                # stamp the perf-gate history schema on every phase
+                # payload (analysis/perfgate.py ingests these rounds)
+                payload.setdefault("gate_schema", _gate_schema())
+            return payload, None
         return None, f"{tag}: rc={proc.returncode} {proc.stderr[-200:]}"
     except subprocess.TimeoutExpired:
         return None, f"{tag}: timeout"
@@ -1125,6 +1172,7 @@ def main() -> None:
         "value": 0.0,
         "unit": "TFLOPs/chip",
         "vs_baseline": 0.0,
+        "gate_schema": _gate_schema(),
     }
     # fast health gate: this image's TPU tunnel can wedge such that even
     # jax.devices() hangs; don't burn the full fallback budget in that
@@ -1135,23 +1183,9 @@ def main() -> None:
     # retry before the round is declared wedged.  On failure the emitted
     # JSON is unchanged: error + last_measured standing numbers, so a
     # wedged round still never reads as "this framework benches 0.0".
-    # load resilience.py by file path, NOT through the package: the
-    # package __init__ imports flax/jax, and the parent must touch no jax
-    # code before the subprocess-isolated probe (a wedged tunnel can hang
-    # jax-level work — the exact state this gate exists to detect).
-    # resilience.py itself is stdlib-only at module level by design.
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "_bench_resilience",
-        os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "ring_attention_tpu", "utils", "resilience.py",
-        ),
+    _resilience = _load_repo_module(
+        "_bench_resilience", "ring_attention_tpu", "utils", "resilience.py"
     )
-    _resilience = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = _resilience  # dataclass field resolution
-    spec.loader.exec_module(_resilience)
     RetryError, with_retries = _resilience.RetryError, _resilience.with_retries
 
     def _probe_device():

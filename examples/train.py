@@ -10,6 +10,7 @@ char-level model on synthetic data and prints loss + throughput.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 import warnings
@@ -110,6 +111,16 @@ def main() -> None:
                          "tools/trace_report.py (docs/observability.md)")
     ap.add_argument("--log-every", type=int, default=5,
                     help="steps between metric rows / console lines")
+    ap.add_argument("--flight-window", type=int, default=64,
+                    help="numerics flight recorder: keep the last N metric "
+                         "rows in memory and dump them as JSON on a "
+                         "nonfinite step, kernel degradation, or crash — "
+                         "a NaN arrives with its preceding trajectory, "
+                         "not a bare counter (needs --metrics-dir; 0 "
+                         "disables; docs/observability.md §Observatory)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="flight-dump directory (default: "
+                         "METRICS_DIR/flight)")
     args = ap.parse_args()
     if args.log_every < 1:
         ap.error("--log-every must be >= 1")
@@ -333,7 +344,51 @@ def main() -> None:
         except Exception:  # noqa: BLE001 — diagnostics never fail the run
             pass
 
+    # numerics flight recorder (docs/observability.md §Observatory): the
+    # last --flight-window metric rows ride in memory; a nonfinite step,
+    # kernel degradation, exhausted retry ladder, or crash dumps them as
+    # JSON next to the metrics — the NaN arrives with its trajectory
+    recorder = None
+    if collect and args.flight_window > 0:
+        from ring_attention_tpu.utils import FlightRecorder
+
+        recorder = FlightRecorder(
+            args.flight_dir or os.path.join(args.metrics_dir, "flight"),
+            window=args.flight_window,
+            context={
+                "mesh": dict(mesh.shape) if mesh is not None else None,
+                "seq_len": args.seq_len, "batch": args.batch,
+                "dim": args.dim, "depth": args.depth,
+                "ulysses": ulysses, "ring": ring,
+                "counter_rotate": args.counter_rotate,
+                "hop_compression": args.hop_compression,
+                "remat_policy": args.remat_policy,
+                "ff_chunk_size": args.ff_chunk_size,
+                "skip_nonfinite": guarded,
+            },
+        ).install()
+
     timer = StepTimer(tokens_per_step=tokens.size)
+    loop_guard = recorder.guard() if recorder is not None else (
+        contextlib.nullcontext()
+    )
+    with loop_guard:
+        _train_loop(args, recorder, timer, train_step, params, opt_state,
+                    metrics, stats, batch, collect, guarded, mgr, logger,
+                    start, mfu_flops, comms, peak)
+    if logger is not None:
+        logger.close()
+        print(f"metrics: {logger.path} (render with tools/trace_report.py)")
+    if recorder is not None and recorder.dumps:
+        print("flight dumps: " + ", ".join(recorder.dumps))
+
+
+def _train_loop(args, recorder, timer, train_step, params, opt_state,
+                metrics, stats, batch, collect, guarded, mgr, logger,
+                start, mfu_flops, comms, peak):
+    from ring_attention_tpu.utils import achieved_mfu
+    from ring_attention_tpu.utils.train import StepStats
+
     for step in range(start, args.steps):
         if collect:
             params, opt_state, metrics, loss = train_step(
@@ -343,6 +398,11 @@ def main() -> None:
             # uninstrumented runs; it mirrors the metrics counters
             stats = StepStats(step_ok=metrics.step_ok,
                               skipped=metrics.skipped)
+            if recorder is not None:
+                dump = recorder.observe_step(step, metrics)
+                if dump:
+                    print(f"flight recorder: nonfinite step {step} -> "
+                          f"{dump}")
         elif guarded:
             params, opt_state, stats, loss = train_step(
                 params, opt_state, stats, *batch
@@ -383,9 +443,6 @@ def main() -> None:
             if collect:
                 ckpt["nonfinite"] = metrics.nonfinite
             mgr.save(step, ckpt)
-    if logger is not None:
-        logger.close()
-        print(f"metrics: {logger.path} (render with tools/trace_report.py)")
 
 
 if __name__ == "__main__":

@@ -10,16 +10,32 @@ Three passes, all CPU-runnable in tier-1 (see docs/static_analysis.md):
     entropy in traced code, unvalidated entry points);
   - :mod:`~ring_attention_tpu.analysis.recompile` — retrace sentinel
     (each entry point compiles exactly once per shape) and the f32
-    accumulator-dtype audit.
+    accumulator-dtype audit;
+  - :mod:`~ring_attention_tpu.analysis.perfgate` — the perf-observatory
+    regression gate: BENCH_r*.json / hwlog history ingest + CPU-signal
+    checks against ``docs/perf_baseline.json`` (wedge-honest: rounds
+    whose TPU probe never ran are recorded, never silently passed).
 
-CLI: ``tools/check_contracts.py`` (full contract suite) and
-``python -m ring_attention_tpu.analysis`` (lint + dtype audit self-run).
+CLI: ``tools/check_contracts.py`` (full contract suite),
+``tools/perf_gate.py`` (the regression gate), and
+``python -m ring_attention_tpu.analysis`` (lint + dtype audit +
+compile-free gate self-run).
 On a host without jax, run the lint as a plain script —
 ``python ring_attention_tpu/analysis/lint.py`` — which skips this
 package ``__init__`` chain entirely.
 """
 
 from .lint import Violation, lint_file, lint_package, lint_source
+from .perfgate import (
+    GATE_SCHEMA_VERSION,
+    GateFinding,
+    GateReport,
+    History,
+    collect_current,
+    load_history,
+    run_gate,
+    write_baseline,
+)
 from .recompile import (
     CompileCounter,
     RetraceError,
@@ -32,8 +48,16 @@ from .recompile import (
 
 __all__ = [
     "CompileCounter",
+    "GATE_SCHEMA_VERSION",
+    "GateFinding",
+    "GateReport",
+    "History",
     "RetraceError",
     "Violation",
+    "collect_current",
+    "load_history",
+    "run_gate",
+    "write_baseline",
     "assert_compiles_once",
     "audit_accumulator_dtypes",
     "audit_donation",

@@ -1,9 +1,15 @@
 """Self-run: ``python -m ring_attention_tpu.analysis``.
 
-Lints the whole package tree and, unless ``--no-audit``, runs the f32
-accumulator-dtype audit.  Exit status 0 = clean.  The ``-m`` form imports
-the package ``__init__`` chain (which needs jax); on a host without jax,
-run the lint as a plain script instead:
+Lints the whole package tree, runs the f32 accumulator-dtype audit
+(unless ``--no-audit``), and runs the perf-observatory gate (unless
+``--no-gate``): benchmark-history trend checks plus the arithmetic
+comms-reference table against ``docs/perf_baseline.json``.  The default
+gate pass compiles nothing; ``--gate-full`` adds the collective
+fingerprint and the reference-step compiled cost/memory signals (what
+``tools/perf_gate.py --check`` runs).  Exit status 0 = clean.
+
+The ``-m`` form imports the package ``__init__`` chain (which needs
+jax); on a host without jax, run the lint as a plain script instead:
 ``python ring_attention_tpu/analysis/lint.py``.  The full
 collective-contract suite needs virtual devices and lives in
 ``tools/check_contracts.py``.
@@ -14,21 +20,37 @@ from __future__ import annotations
 import argparse
 
 from .lint import lint_package
-from . import recompile
+from . import perfgate, recompile
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ring_attention_tpu.analysis",
-        description="lint the package tree + audit kernel accumulator dtypes",
+        description="lint the package tree + audit kernel accumulator "
+                    "dtypes + run the perf-observatory gate",
     )
     parser.add_argument("--no-audit", action="store_true",
                         help="skip the (jax-importing) f32 accumulator audit")
+    parser.add_argument("--no-gate", action="store_true",
+                        help="skip the perf gate (history + comms baseline)")
+    parser.add_argument("--gate-full", action="store_true",
+                        help="gate on the full CPU signal set (fingerprint "
+                             "+ reference-step compile) — pays compiles; "
+                             "the default gates only the compile-free "
+                             "signals")
     args = parser.parse_args(argv)
 
     failures = [str(v) for v in lint_package()]
     if not args.no_audit:
         failures.extend(recompile.audit_accumulator_dtypes())
+    if not args.no_gate:
+        if args.gate_full:
+            current = perfgate.collect_current()
+        else:
+            current = perfgate.collect_current(strategies=None,
+                                               compiled=False)
+        report = perfgate.run_gate(current)
+        failures.extend(str(f) for f in report.findings)
     for line in failures:
         print(line)
     print(f"{len(failures)} finding(s)" if failures else "clean")

@@ -30,6 +30,13 @@ past PR, with the shim/convention that prevents it:
          that never calls ``utils/validate.check_attention_args`` — layout
          bugs then surface as einsum errors deep in the kernels instead of
          a one-line ValueError at the API boundary.
+  RA008  ``Telemetry.observe`` in library code outside a ``with
+         ...collecting()`` block, or with a metric name lacking a unit
+         suffix (``_bytes``/``_sec``/``_count``/``_frac``).  ``observe``
+         only lands when a collector is active AT THE SAME TRACE LEVEL —
+         a library-level call outside any ``collecting()`` silently drops
+         every scalar it claims to record; and an unsuffixed name
+         ("kv_hop") reads as whatever unit the dashboard author guesses.
 
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
@@ -66,6 +73,9 @@ COLLECTIVE_CALLS = {
 }
 
 HOST_TIME_ATTRS = {"time", "time_ns", "perf_counter", "monotonic", "process_time"}
+
+# RA008: metric-name unit suffixes (docs/observability.md glossary)
+METRIC_UNIT_SUFFIXES = ("_bytes", "_sec", "_count", "_frac")
 
 _ALLOW_RE = re.compile(r"#\s*ra:\s*allow\(\s*(RA\d{3})\b([^)]*)\)")
 
@@ -110,6 +120,7 @@ class _Linter(ast.NodeVisitor):
         self.lines = source.splitlines()
         self.violations: list[Violation] = []
         self.scope_depth = 0  # nesting inside `with jax.named_scope(...)`
+        self.collecting_depth = 0  # nesting inside `with ....collecting()`
         self.is_shim = rel.replace("\\", "/").endswith(SHIM_MODULE)
         self.traced_pkg = any(
             rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
@@ -203,24 +214,51 @@ class _Linter(ast.NodeVisitor):
             self.flag(node, "RA006",
                       "print() in library code — use warnings or telemetry")
 
+        if (name == "observe" and isinstance(func, ast.Attribute)
+                and not self.rel.replace("\\", "/").endswith(
+                    "utils/telemetry.py")):  # the registry's own module
+            if self.collecting_depth == 0:
+                self.flag(node, "RA008",
+                          "Telemetry.observe outside a collecting() block — "
+                          "observations only land when a collector is "
+                          "active at the same trace level; this scalar "
+                          "silently drops")
+            metric = node.args[0] if node.args else None
+            if (isinstance(metric, ast.Constant)
+                    and isinstance(metric.value, str)
+                    and not metric.value.endswith(METRIC_UNIT_SUFFIXES)):
+                self.flag(node, "RA008",
+                          f"metric name {metric.value!r} lacks a unit "
+                          f"suffix ({'/'.join(METRIC_UNIT_SUFFIXES)}) — "
+                          "an unitless series reads as whatever the "
+                          "dashboard author guesses")
+
         self.generic_visit(node)
 
     def visit_With(self, node: ast.With) -> None:
-        named = any(
-            isinstance(item.context_expr, ast.Call)
-            and (
-                (isinstance(item.context_expr.func, ast.Attribute)
-                 and item.context_expr.func.attr == "named_scope")
-                or (isinstance(item.context_expr.func, ast.Name)
-                    and item.context_expr.func.id == "named_scope")
+        def _ctx_is(call_name: str) -> bool:
+            return any(
+                isinstance(item.context_expr, ast.Call)
+                and (
+                    (isinstance(item.context_expr.func, ast.Attribute)
+                     and item.context_expr.func.attr == call_name)
+                    or (isinstance(item.context_expr.func, ast.Name)
+                        and item.context_expr.func.id == call_name)
+                )
+                for item in node.items
             )
-            for item in node.items
-        )
+
+        named = _ctx_is("named_scope")
+        collecting = _ctx_is("collecting")
         if named:
             self.scope_depth += 1
+        if collecting:
+            self.collecting_depth += 1
         self.generic_visit(node)
         if named:
             self.scope_depth -= 1
+        if collecting:
+            self.collecting_depth -= 1
 
     # -- RA007: entry points must validate ----------------------------
     def _check_entry_point(self, node: ast.FunctionDef) -> None:
@@ -288,7 +326,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA007)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA008)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

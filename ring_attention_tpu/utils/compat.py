@@ -85,6 +85,34 @@ def jit(fn, *, donate_argnums=(), **kwargs):
     return jax.jit(fn, **kwargs)
 
 
+def profiler_trace(logdir: str):
+    """``jax.profiler.trace(logdir)`` across the 0.4.x → 0.5+ surface.
+
+    The context-manager form exists everywhere this repo runs, but newer
+    releases grew extra keyword defaults (``create_perfetto_link``/
+    ``create_perfetto_trace``) whose *absence* is the portable spelling —
+    and on builds without the context manager at all, the start/stop pair
+    is composed into one here.  Callers go through
+    ``utils/profiling.trace`` (docs/observability.md §Observatory); this
+    shim is the single place a profiler entry-point difference may live.
+    """
+    cm = getattr(jax.profiler, "trace", None)
+    if cm is not None:
+        return cm(logdir)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _fallback():
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+
+    return _fallback()
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` (new name) or ``pltpu.TPUCompilerParams``
     (old name) — same dataclass across the rename; every field this repo

@@ -1,39 +1,62 @@
-"""Profiling and throughput measurement.
+"""Profiling: trace capture, step throughput, and the measured-overlap
+observatory.
 
 The reference has no timers or profiler hooks at all (SURVEY §5).  On TPU
 the platform profiler (XProf via ``jax.profiler``) is the ground truth for
 MXU utilization and ICI overlap; this module adds the pieces a training
-loop actually calls: a trace context, named annotations, and a
-step-throughput meter.  The hot paths across ``parallel/`` and ``ops/``
-are wrapped in stable ``jax.named_scope`` names (``ring/hop{i}``,
-``ulysses/a2a_in``, ``hybrid/inner``, ``tree_decode/gather``, …) so an
-XProf capture attributes device time to stages — ``tools/trace_report.py``
-renders the resulting per-stage table.
+loop actually calls — a trace context, named annotations, a
+step-throughput meter — plus the **reader** side: a stdlib-only parser
+for the ``.xplane.pb`` captures the profiler writes, a per-hop/per-stage
+timeline reconstruction keyed on the stack's stable ``jax.named_scope``
+names (``ring/hop{i}``, ``ring/rotate{i}``, ``ulysses/a2a_in``, …), and a
+*measured* compute/transfer overlap fraction to sit next to the analytic
+one from ``telemetry.ring_comms_accounting`` — Ring Attention's whole
+premise ("KV hops hide under blockwise compute") as a number read off the
+hardware timeline, not a model (docs/observability.md §Observatory).
+
+Like ``telemetry.py``/``resilience.py``, this module is stdlib-only at
+module level (jax is imported inside functions), so ``tools/
+trace_report.py`` can load it by file path on a box where jax cannot
+import.  The xplane parser is a ~150-line protobuf wire-format reader —
+the TensorFlow proto stubs this image lacks are NOT required: op events
+carry HLO instruction names and a ``program_id``, the ``/host:metadata``
+plane embeds each program's ``HloProto``, and joining the two recovers
+the full ``op_name`` scope path for every event.
 """
 
 from __future__ import annotations
 
 import contextlib
+import glob
+import os
+import re
 import statistics
 import time
 import warnings
 from dataclasses import dataclass, field
-
-import jax
+from typing import Any, Iterator, NamedTuple
 
 
 @contextlib.contextmanager
 def trace(logdir: str):
     """Capture an XLA/TPU profile viewable in XProf/TensorBoard.
 
+    Goes through ``utils/compat.profiler_trace`` so the jax-0.4.x
+    entry-point differences stay in the shim (docs/observability.md).
+
     >>> with trace("/tmp/profile"):
     ...     step(...)  # traced region
     """
-    jax.profiler.start_trace(logdir)
     try:
+        from . import compat
+
+        cm = compat.profiler_trace(logdir)
+    except ImportError:  # standalone file-path load (tools/)
+        import jax
+
+        cm = jax.profiler.trace(logdir)
+    with cm:
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def annotate(name: str):
@@ -48,6 +71,8 @@ def annotate(name: str):
     >>> with annotate("train/step"):
     ...     loss = step(...)
     """
+    import jax
+
     return jax.profiler.TraceAnnotation(name)
 
 
@@ -74,6 +99,8 @@ class StepTimer:
 
     def step(self, result=None) -> None:
         if result is not None:
+            import jax
+
             jax.block_until_ready(result)
             if self.tokens_per_step == 0 and not self._warned_no_tokens:
                 # tokens_per_sec would read 0.0 forever — say so ONCE
@@ -126,11 +153,602 @@ class StepTimer:
         deltas = self._deltas()
         if not deltas:
             return 0.0
-        if len(deltas) == 1:
-            return deltas[0] * 1e3
-        deltas = sorted(deltas)
-        pos = 0.95 * (len(deltas) - 1)
-        lo = int(pos)
-        hi = min(lo + 1, len(deltas) - 1)
-        frac = pos - lo
-        return (deltas[lo] * (1 - frac) + deltas[hi] * frac) * 1e3
+        return percentile([d * 1e3 for d in deltas], 0.95)
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Linear-interpolation percentile (numpy 'linear' method), 0.0 on
+    empty input — shared by the timer, the timeline, and trace_report."""
+    if not values:
+        return 0.0
+    values = sorted(values)
+    if len(values) == 1:
+        return values[0]
+    pos = q * (len(values) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(values) - 1)
+    frac = pos - lo
+    return values[lo] * (1 - frac) + values[hi] * frac
+
+
+# ----------------------------------------------------------------------
+# xplane.pb wire-format parser (stdlib-only)
+# ----------------------------------------------------------------------
+#
+# Field numbers below are the stable public schema of
+# tensorflow/tsl/profiler/protobuf/xplane.proto and xla/service/hlo.proto
+# (unchanged across every TF/XLA release this stack can meet).  Only the
+# fields the observatory needs are decoded; unknown fields are skipped by
+# wire type, so schema additions cannot break the reader.
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _wire_fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield ``(field_number, wire_type, value)`` triples of one message.
+
+    wire type 0 -> int, 2 -> bytes, 1/5 -> raw 8/4 bytes.  Groups (3/4)
+    do not occur in these protos; an unknown type aborts the message
+    rather than guessing at framing.
+    """
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:  # unknown framing: stop decoding this message
+            return
+        yield fn, wt, v
+
+
+def _hlo_scopes(hlo_proto: bytes) -> dict[str, str]:
+    """``{instruction_name: op_name}`` from a serialized ``HloProto``.
+
+    HloProto.hlo_module=1 -> HloModuleProto.computations=3 ->
+    HloComputationProto.instructions=2 -> HloInstructionProto.name=1 /
+    .metadata=7 -> OpMetadata.op_name=2 (the ``jit(f)/…/ring/hop0/…``
+    scope path the named_scope annotations put there).
+    """
+    out: dict[str, str] = {}
+    for fn, _, module in _wire_fields(hlo_proto):
+        if fn != 1:
+            continue
+        for mfn, _, comp in _wire_fields(module):
+            if mfn != 3:
+                continue
+            for cfn, _, instr in _wire_fields(comp):
+                if cfn != 2:
+                    continue
+                name = scope = ""
+                for ifn, _, val in _wire_fields(instr):
+                    if ifn == 1:
+                        name = val.decode(errors="replace")
+                    elif ifn == 7:
+                        for ofn, _, oval in _wire_fields(val):
+                            if ofn == 2:
+                                scope = oval.decode(errors="replace")
+                if name and scope:
+                    out[name] = scope
+    return out
+
+
+class OpEvent(NamedTuple):
+    """One profiled op occurrence with its resolved scope path."""
+
+    plane: str
+    line: str
+    name: str       # HLO instruction name ("dot.14", "collective-permute.4")
+    scope: str      # op_name metadata path ("" when the join found none)
+    stage: str      # stage label from STAGES ("other" when unmatched)
+    kind: str       # "compute" | "transfer" | "other"
+    start_ns: int
+    dur_ns: int
+
+    @property
+    def end_ns(self) -> int:
+        return self.start_ns + self.dur_ns
+
+
+# stage buckets keyed on the stable scope/kernel names threaded through
+# parallel/ and ops/ (docs/observability.md §4): (needle, label, kind),
+# first match wins.  "transfer" = inter-device payload movement the ring
+# schedule wants hidden under "compute".
+STAGES: list[tuple[str, str, str]] = [
+    ("ring/rotate", "ring kv rotation", "transfer"),
+    ("ring/catchup", "ring dkv catch-up", "transfer"),
+    ("ring/bwd", "ring backward", "compute"),
+    ("ring/hop", "ring hop compute", "compute"),
+    ("kv_head_reshard", "gqa kv reshard", "transfer"),
+    ("ulysses/a2a", "ulysses all-to-all", "transfer"),
+    ("ulysses/flash", "ulysses local flash", "compute"),
+    ("hybrid/a2a", "hybrid all-to-all", "transfer"),
+    ("hybrid/inner", "hybrid inner ring", "compute"),
+    ("zigzag/gather", "zigzag gather", "transfer"),
+    ("zigzag/", "zigzag", "compute"),
+    ("tree_decode/gather", "tree-decode merge", "transfer"),
+    ("tree_decode/", "tree-decode local", "compute"),
+    ("flash_bwd", "flash backward kernel", "compute"),  # pallas kernel name
+    ("flash/bwd", "flash backward", "compute"),  # XLA-path named_scope
+    ("flash_decode", "flash decode kernel", "compute"),
+    ("flash", "flash forward kernel", "compute"),
+]
+
+# instruction-name prefixes that are payload movement even when no scope
+# attributed them (an unattributed collective is itself a finding — RA004
+# lints the source side of this)
+_COLLECTIVE_PREFIXES = (
+    "collective-permute", "all-to-all", "all-gather", "all-reduce",
+    "reduce-scatter", "collective-broadcast",
+)
+
+_HOP_RE = re.compile(r"ring/(?:bwd_)?hop(\d+)")
+_ROTATE_RE = re.compile(r"ring/rotate(\d+)")
+
+
+def stage_of(name: str, scope: str = "") -> tuple[str, str]:
+    """``(label, kind)`` for an op: scope needles first (first match in
+    STAGES wins), then the bare-collective fallback, else ``other``."""
+    hay = (scope or name).lower()
+    for needle, label, kind in STAGES:
+        if needle in hay:
+            return label, kind
+    if name.startswith(_COLLECTIVE_PREFIXES):
+        return "unattributed collective", "transfer"
+    return "other", "other"
+
+
+def _xplane_paths(path: str) -> list[str]:
+    if os.path.isdir(path):
+        return sorted(
+            glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True),
+            key=os.path.getmtime,
+        )
+    return [path]
+
+
+def read_xplane_events(path: str) -> tuple[list[OpEvent], str]:
+    """Parse the newest ``*.xplane.pb`` under ``path`` (or the file
+    itself) into resolved :class:`OpEvent` rows.
+
+    Returns ``(events, note)`` — ``note`` is a human-readable degradation
+    reason when nothing could be parsed (missing capture, no op events),
+    empty on success.  Never raises on malformed input: the timeline is a
+    diagnostic, not a gate.
+    """
+    paths = _xplane_paths(path)
+    if not paths:
+        return [], f"no .xplane.pb under {path}"
+    try:
+        data = open(paths[-1], "rb").read()
+    except OSError as e:
+        return [], f"unreadable capture: {e}"
+    # program_id -> {instruction: scope}; module_name -> same (fallback)
+    scopes_by_id: dict[int, dict[str, str]] = {}
+    scopes_by_module: dict[str, dict[str, str]] = {}
+    op_planes: list[bytes] = []
+    try:
+        for fn, _, plane in _wire_fields(data):
+            if fn != 1:
+                continue
+            pname = ""
+            for pfn, _, pval in _wire_fields(plane):
+                if pfn == 2:
+                    pname = pval.decode(errors="replace")
+                    break
+            if "metadata" in pname:
+                _index_metadata_plane(plane, scopes_by_id, scopes_by_module)
+            else:
+                op_planes.append(plane)
+        events: list[OpEvent] = []
+        for plane in op_planes:
+            events.extend(
+                _plane_events(plane, scopes_by_id, scopes_by_module)
+            )
+    except (IndexError, ValueError, OverflowError) as e:
+        # a capture truncated mid-write (killed profiler — the wedge mode
+        # this repo knows well) degrades to a note, never a traceback
+        return [], (
+            f"malformed capture {paths[-1]}: {type(e).__name__}: {e}"
+        )
+    if not events:
+        return [], f"no op events parsed from {paths[-1]}"
+    return events, ""
+
+
+def _index_metadata_plane(
+    plane: bytes,
+    by_id: dict[int, dict[str, str]],
+    by_module: dict[str, dict[str, str]],
+) -> None:
+    """The ``/host:metadata`` plane: each event-metadata entry is one
+    profiled program; its ``hlo_proto`` stat holds the serialized
+    HloProto whose OpMetadata carries the named_scope paths."""
+    for pfn, _, entry in _wire_fields(plane):
+        if pfn != 4:  # event_metadata map entry
+            continue
+        for efn, _, meta in _wire_fields(entry):
+            if efn != 2:  # XEventMetadata
+                continue
+            meta_id = None
+            module_name = ""
+            blobs: list[bytes] = []
+            for mfn, mwt, mval in _wire_fields(meta):
+                if mfn == 1 and mwt == 0:
+                    meta_id = mval
+                elif mfn == 2:
+                    module_name = mval.decode(errors="replace")
+                elif mfn in (3, 5):
+                    # field 3: raw metadata bytes; field 5: XStat whose
+                    # bytes_value (field 6) carries the proto — both
+                    # spellings exist in the wild
+                    if mfn == 3:
+                        blobs.append(mval)
+                    else:
+                        for sfn, _, sval in _wire_fields(mval):
+                            if sfn == 6:
+                                blobs.append(sval)
+            for blob in blobs:
+                scopes = _hlo_scopes(blob)
+                if not scopes:
+                    continue
+                if meta_id is not None:
+                    by_id.setdefault(meta_id, {}).update(scopes)
+                if module_name:
+                    by_module.setdefault(module_name, {}).update(scopes)
+
+
+def _plane_events(
+    plane: bytes,
+    by_id: dict[int, dict[str, str]],
+    by_module: dict[str, dict[str, str]],
+) -> list[OpEvent]:
+    pname = ""
+    metas: dict[int, str] = {}
+    stat_names: dict[int, str] = {}
+    lines: list[bytes] = []
+    for pfn, _, pval in _wire_fields(plane):
+        if pfn == 2:
+            pname = pval.decode(errors="replace")
+        elif pfn == 3:
+            lines.append(pval)
+        elif pfn == 4:  # event_metadata map entry -> id, name
+            mid, mname = None, ""
+            for efn, ewt, meta in _wire_fields(pval):
+                if efn == 1 and ewt == 0:  # map key == metadata id
+                    mid = meta
+                elif efn == 2:  # XEventMetadata
+                    for mfn, mwt, mval in _wire_fields(meta):
+                        if mfn == 1 and mwt == 0:
+                            mid = mval
+                        elif mfn == 2:
+                            mname = mval.decode(errors="replace")
+            if mid is not None:
+                metas[mid] = mname
+        elif pfn == 5:  # stat_metadata map entry -> id, name
+            sid, sname = None, ""
+            for efn, _, meta in _wire_fields(pval):
+                if efn == 1:
+                    sid = meta
+                elif efn == 2:
+                    for mfn, mwt, mval in _wire_fields(meta):
+                        if mfn == 1 and mwt == 0:
+                            sid = mval
+                        elif mfn == 2:
+                            sname = mval.decode(errors="replace")
+            if sid is not None:
+                stat_names[sid] = sname
+    out: list[OpEvent] = []
+    parsed_lines: list[tuple[str, int, list[bytes]]] = []
+    for line_buf in lines:
+        lname = ""
+        ts_ns = 0
+        evs: list[bytes] = []
+        for lfn, lwt, lval in _wire_fields(line_buf):
+            if lfn == 2:
+                lname = lval.decode(errors="replace")
+            elif lfn == 3 and lwt == 0:
+                ts_ns = lval
+            elif lfn == 4:
+                evs.append(lval)
+        parsed_lines.append((lname, ts_ns, evs))
+    # device planes (TPU) carry an "XLA Ops" line plus DERIVED lines
+    # (step, framework-name-scope) describing the same wall-clock spans;
+    # counting both would double every op.  When a plane has op lines,
+    # only they enter the timeline; CPU planes (one thunk line per
+    # thread, no derived lines) keep everything.
+    op_lines = [pl for pl in parsed_lines if "XLA Ops" in pl[0]]
+    if op_lines:
+        parsed_lines = op_lines
+    for lname, ts_ns, evs in parsed_lines:
+        for ev in evs:
+            mid = None
+            offset_ps = dur_ps = 0
+            program_id = None
+            module_ref = None
+            for efn, ewt, eval_ in _wire_fields(ev):
+                if efn == 1 and ewt == 0:
+                    mid = eval_
+                elif efn == 2 and ewt == 0:
+                    offset_ps = eval_
+                elif efn == 3 and ewt == 0:
+                    dur_ps = eval_
+                elif efn == 4:  # XStat
+                    smid = None
+                    val = None
+                    for sfn, swt, sval in _wire_fields(eval_):
+                        if sfn == 1 and swt == 0:
+                            smid = sval
+                        elif sfn in (3, 4, 7) and swt == 0:
+                            val = sval
+                    sname = stat_names.get(smid, "")
+                    if sname == "program_id":
+                        program_id = val
+                    elif sname == "hlo_module" and val is not None:
+                        module_ref = stat_names.get(val, "")
+            name = metas.get(mid, "")
+            if not name or not dur_ps:
+                continue
+            if program_id is None and not module_ref:
+                # only HLO-attributed op events enter the timeline: host
+                # python-tracer/TraceMe spans (a dispatch wrapper named
+                # after the jitted fn, a ThreadpoolListener) would
+                # otherwise bucket as compute and corrupt busy time and
+                # the measured overlap (the needle match runs on NAMES
+                # when no scope resolves)
+                continue
+            scope = ""
+            if program_id is not None and program_id in by_id:
+                scope = by_id[program_id].get(name, "")
+            if not scope and module_ref and module_ref in by_module:
+                scope = by_module[module_ref].get(name, "")
+            if not scope and len(by_module) == 1:
+                scope = next(iter(by_module.values())).get(name, "")
+            label, kind = stage_of(name, scope)
+            out.append(OpEvent(
+                plane=pname, line=lname, name=name, scope=scope,
+                stage=label, kind=kind,
+                start_ns=ts_ns * 1000 + offset_ps,  # both in picoseconds
+                dur_ns=dur_ps,
+            ))
+    # start/dur computed in ps above; convert once here so one unit rules
+    return [
+        e._replace(start_ns=e.start_ns // 1000, dur_ns=max(e.dur_ns // 1000, 1))
+        for e in out
+    ]
+
+
+# ----------------------------------------------------------------------
+# Timeline reconstruction + measured overlap
+# ----------------------------------------------------------------------
+
+
+def stage_timeline(
+    events: list[OpEvent], *, ring_size: int | None = None
+) -> dict[str, Any]:
+    """Per-stage/per-hop timeline over one capture.
+
+    Returns::
+
+        {"stages": [{"stage", "kind", "events", "busy_ms",
+                     "p50_ms", "p95_ms"}, ...],        # busy-desc order
+         "hops":   [{"hop", "compute_ms", "transfer_ms",
+                     "samples"}, ...],                  # hop index order
+         "total_busy_ms": float}
+
+    ``p50/p95`` are over stage *instances* — one sample per (line, stage,
+    hop-index) group, i.e. per device-thread occurrence — not per HLO op,
+    so a hop that fragments into 40 fusions still reads as one latency
+    sample.  ``hops`` resolves per-hop indices into the compute-vs-
+    transfer table the overlap story is about: the unrolled Pallas path
+    carries static ``ring/hop{i}`` / ``ring/rotate{i}`` scope indices; the
+    XLA scan path re-runs ONE set of instructions per hop, so its indices
+    are reconstructed temporally — on each timeline line, hop ``i`` is
+    whatever runs after the line's ``i``-th completed KV rotation (an
+    approximation when a thread pool interleaves devices on one line, so
+    ``hops`` rows carry their ``samples`` count for sanity).
+
+    A capture should normally cover ONE step (the xprof_capture
+    practice); for a multi-step capture pass ``ring_size`` so hop indices
+    fold modulo the ring and each step contributes its own latency
+    sample (hop-index DECREASES on a line mark the step boundary —
+    without ``ring_size`` the scan path's temporal counter keeps
+    growing and a multi-step capture reads as one long hop sequence).
+    """
+    instances: dict[tuple, float] = {}
+    stage_events: dict[str, int] = {}
+    stage_kind: dict[str, str] = {}
+    hop_busy: dict[int, dict[str, float]] = {}
+    hop_samples: dict[int, int] = {}
+    rotations_seen: dict[tuple[str, str], int] = {}
+    prev_hop: dict[tuple[str, str], int] = {}
+    cycles: dict[tuple[str, str], int] = {}
+    for ev in sorted(events, key=lambda e: e.start_ns):
+        if ev.stage == "other":
+            continue
+        line_key = (ev.plane, ev.line)
+        hop = None
+        m = _HOP_RE.search(ev.scope) or _ROTATE_RE.search(ev.scope)
+        if m:
+            hop = int(m.group(1))
+        elif ev.stage == "ring kv rotation":
+            hop = rotations_seen.get(line_key, 0)
+            if ev.name.startswith("collective-permute"):
+                # the permute op itself advances the line's hop counter;
+                # its satellite copies/converts stay on the same index
+                rotations_seen[line_key] = hop + 1
+        elif ev.stage in ("ring hop compute", "ring backward"):
+            hop = rotations_seen.get(line_key, 0)
+        cycle = 0
+        if hop is not None:
+            if ring_size:
+                hop %= ring_size
+            # a hop index going BACKWARDS on a line = a new step/cycle:
+            # its occurrences become fresh latency samples instead of
+            # accumulating into the first step's instance
+            if hop < prev_hop.get(line_key, hop):
+                cycles[line_key] = cycles.get(line_key, 0) + 1
+            prev_hop[line_key] = hop
+            cycle = cycles.get(line_key, 0)
+        key = (ev.plane, ev.line, ev.stage, hop, cycle)
+        first = key not in instances
+        instances[key] = instances.get(key, 0.0) + ev.dur_ns / 1e6
+        stage_events[ev.stage] = stage_events.get(ev.stage, 0) + 1
+        stage_kind[ev.stage] = ev.kind
+        if hop is not None:
+            slot = hop_busy.setdefault(hop, {"compute": 0.0, "transfer": 0.0})
+            if ev.kind in slot:
+                slot[ev.kind] += ev.dur_ns / 1e6
+            if first:
+                hop_samples[hop] = hop_samples.get(hop, 0) + 1
+    per_stage: dict[str, list[float]] = {}
+    for (_, _, stage, _, _), busy in instances.items():
+        per_stage.setdefault(stage, []).append(busy)
+    stages = [
+        {
+            "stage": stage,
+            "kind": stage_kind[stage],
+            "events": stage_events[stage],
+            "busy_ms": round(sum(samples), 4),
+            "p50_ms": round(percentile(samples, 0.5), 4),
+            "p95_ms": round(percentile(samples, 0.95), 4),
+        }
+        for stage, samples in per_stage.items()
+    ]
+    stages.sort(key=lambda r: -r["busy_ms"])
+    hops = [
+        {
+            "hop": hop,
+            "compute_ms": round(hop_busy[hop]["compute"], 4),
+            "transfer_ms": round(hop_busy[hop]["transfer"], 4),
+            "samples": hop_samples.get(hop, 0),
+        }
+        for hop in sorted(hop_busy)
+    ]
+    return {
+        "stages": stages,
+        "hops": hops,
+        "total_busy_ms": round(sum(r["busy_ms"] for r in stages), 4),
+    }
+
+
+def _merge_intervals(spans: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    if not spans:
+        return []
+    spans = sorted(spans)
+    out = [spans[0]]
+    for lo, hi in spans[1:]:
+        if lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def measured_overlap(events: list[OpEvent]) -> dict[str, Any]:
+    """Measured compute/transfer overlap over one capture.
+
+    Walks the wall-clock timeline: merges all transfer spans (KV
+    rotations, all-to-alls, catch-up permutes) and all compute spans into
+    interval unions, and reports what fraction of transfer wall time ran
+    concurrently with compute anywhere on the chip —
+    ``overlap_fraction = overlapped_ms / transfer_ms`` (0.0 when the
+    capture has no transfer spans; ``transfer_ms`` of 0 means the
+    schedule's communication never reached the timeline, which is its own
+    finding).  This is the empirical counterpart of
+    ``ring_comms_accounting``'s ``hop_overlap_fraction`` (compute time at
+    peak over max(compute, transfer at ICI bandwidth)): the analytic one
+    says whether the shapes *can* hide the hop, this one says whether the
+    schedule *did*.
+    """
+    transfer = _merge_intervals(
+        [(e.start_ns, e.end_ns) for e in events if e.kind == "transfer"]
+    )
+    compute = _merge_intervals(
+        [(e.start_ns, e.end_ns) for e in events if e.kind == "compute"]
+    )
+    transfer_ns = sum(hi - lo for lo, hi in transfer)
+    compute_ns = sum(hi - lo for lo, hi in compute)
+    overlapped = 0
+    ci = 0
+    for lo, hi in transfer:
+        while ci < len(compute) and compute[ci][1] <= lo:
+            ci += 1
+        cj = ci
+        while cj < len(compute) and compute[cj][0] < hi:
+            overlapped += min(hi, compute[cj][1]) - max(lo, compute[cj][0])
+            cj += 1
+    return {
+        "compute_ms": round(compute_ns / 1e6, 4),
+        "transfer_ms": round(transfer_ns / 1e6, 4),
+        "overlapped_ms": round(overlapped / 1e6, 4),
+        "overlap_fraction": (
+            round(overlapped / transfer_ns, 4) if transfer_ns else 0.0
+        ),
+    }
+
+
+def overlap_report(
+    source: str | list[OpEvent],
+    *,
+    analytic: float | dict | None = None,
+    tolerance: float = 0.25,
+    ring_size: int | None = None,
+) -> dict[str, Any]:
+    """Timeline + measured overlap for a capture, compared against the
+    analytic model when one is supplied.
+
+    ``source`` is a capture directory/file or pre-parsed events;
+    ``analytic`` is ``ring_comms_accounting(...)`` output (its
+    ``hop_overlap_fraction`` is used) or a bare fraction.  When both
+    numbers exist and disagree by more than ``tolerance``, the report
+    carries ``agrees=False`` plus a one-line ``finding`` — a model that
+    no longer describes the hardware is itself a regression
+    (docs/observability.md §Observatory).
+    """
+    if isinstance(source, str):
+        events, note = read_xplane_events(source)
+    else:
+        events, note = source, ""
+    report: dict[str, Any] = {"parsed_events": len(events)}
+    if note:
+        report["note"] = note
+        return report
+    report["timeline"] = stage_timeline(events, ring_size=ring_size)
+    report.update(measured_overlap(events))
+    if analytic is not None:
+        if isinstance(analytic, dict):
+            analytic = analytic.get("hop_overlap_fraction", 0.0)
+        report["analytic_overlap_fraction"] = round(float(analytic), 4)
+        delta = abs(report["overlap_fraction"] - float(analytic))
+        report["overlap_delta"] = round(delta, 4)
+        report["tolerance"] = tolerance
+        report["agrees"] = delta <= tolerance
+        if not report["agrees"]:
+            report["finding"] = (
+                f"measured overlap {report['overlap_fraction']:.3f} vs "
+                f"analytic {float(analytic):.3f} (|delta| "
+                f"{delta:.3f} > tolerance {tolerance:.3f}) — the comms "
+                f"model no longer describes this capture"
+            )
+    return report

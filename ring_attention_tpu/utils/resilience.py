@@ -136,6 +136,48 @@ class RetryError(RuntimeError):
         self.last = last
 
 
+# Callbacks fired when a with_retries ladder exhausts all attempts —
+# the flight-recorder hook (telemetry.FlightRecorder.install): a probe or
+# save that died after its last retry dumps the run's recent trajectory
+# alongside the RetryError, instead of surfacing as a bare exception.
+# Kept as a module-level registry (like DegradationRecord's listeners) so
+# this module stays stdlib-only and import-free of telemetry.
+_failure_listeners: list[Callable[[str, str], None]] = []
+_failure_lock = threading.Lock()
+
+
+def add_failure_listener(callback: Callable[[str, str], None]) -> None:
+    """Register ``callback(where, error)`` to run when a
+    :func:`with_retries` call exhausts its attempts (idempotent per
+    callback).  Callback failures are swallowed — diagnostics must never
+    mask the retried operation's own error.  Pair with
+    :func:`remove_failure_listener` for listeners whose lifetime is
+    shorter than the process (``FlightRecorder.uninstall`` does)."""
+    with _failure_lock:
+        if callback not in _failure_listeners:
+            _failure_listeners.append(callback)
+
+
+def remove_failure_listener(callback: Callable[[str, str], None]) -> None:
+    """Unregister a failure listener (no-op when absent)."""
+    with _failure_lock:
+        if callback in _failure_listeners:
+            _failure_listeners.remove(callback)
+
+
+def _notify_failure(where: str, error: BaseException | None) -> None:
+    text = (
+        f"{type(error).__name__}: {error}" if error is not None else "unknown"
+    )
+    with _failure_lock:
+        listeners = tuple(_failure_listeners)
+    for cb in listeners:
+        try:
+            cb(where, text)
+        except Exception:  # noqa: BLE001 — see add_failure_listener
+            pass
+
+
 def _call_with_timeout(fn: Callable[[], Any], timeout: float) -> Any:
     """Run ``fn()`` with a hard wall-clock budget.
 
@@ -205,6 +247,7 @@ def with_retries(
                 on_retry(attempt, e)
             if attempt + 1 < max_attempts:
                 sleep(backoff * (2**attempt))
+    _notify_failure(getattr(fn, "__name__", None) or "callable", last)
     raise RetryError(
         f"with_retries: all {max_attempts} attempts failed "
         f"(last: {type(last).__name__}: {last})",
@@ -248,6 +291,14 @@ class DegradationRecord:
         with self._lock:
             if callback not in self._listeners:
                 self._listeners.append(callback)
+
+    def remove_listener(self, callback: Callable[[str, str], None]) -> None:
+        """Unregister a listener (no-op when absent) — for listeners
+        whose lifetime is shorter than the process, e.g. a
+        ``FlightRecorder`` bound to one run's directory."""
+        with self._lock:
+            if callback in self._listeners:
+                self._listeners.remove(callback)
 
     def record(self, component: str, reason: BaseException | str) -> None:
         text = f"{type(reason).__name__}: {reason}" if isinstance(

@@ -38,6 +38,7 @@ file path before the subprocess-isolated device probe.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import csv
 import json
@@ -405,6 +406,246 @@ def read_metrics(path: str) -> list[dict[str, Any]]:
             if isinstance(row, dict):
                 rows.append(row)
     return rows
+
+
+# ----------------------------------------------------------------------
+# Numerics flight recorder
+# ----------------------------------------------------------------------
+
+# Flight-dump schema.  v1: {"schema", "trigger": {"kind", "time", ...},
+# "context", "rows": [last-N metric rows, oldest first], "events"}.
+FLIGHT_SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """Rolling ring buffer of the last N metric rows + host events, dumped
+    as one JSON file when something goes wrong.
+
+    A NaN at step 40k is useless as a bare counter; what the operator
+    needs is the preceding trajectory — was ``grad_norm`` trending up for
+    2k steps (diverging run) or flat until one step (bad batch / hardware
+    fault)?  The recorder keeps that trajectory in memory at O(window)
+    cost and writes it only on a trigger:
+
+    - **nonfinite-skip** — :meth:`observe_step` watches the
+      :class:`TrainMetrics` carry and dumps when a step is skipped or the
+      nonfinite counter advances;
+    - **degradation / retry exhaustion** — :meth:`install` registers
+      listeners on ``resilience.degradation`` and the ``with_retries``
+      failure hook;
+    - **crash (incl. RetraceError)** — wrap the loop in :meth:`guard`;
+      any escaping exception dumps before re-raising.
+
+    Dumps are atomic (write-then-rename, like ``utils/checkpoint.py``) so
+    a crash mid-dump can never leave a torn file, and each trigger gets
+    its own numbered file — a cascade (NaN then crash) keeps both.
+    ``context`` (static run config: mesh shape, hop config, remat policy)
+    rides along in every dump.  Format: docs/observability.md
+    §Observatory.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        window: int = 64,
+        registry: Telemetry | None = None,
+        context: dict[str, Any] | None = None,
+        max_dumps_per_trigger: int = 5,
+    ) -> None:
+        if window < 1:
+            raise ValueError(
+                f"FlightRecorder: window must be >= 1, got {window}"
+            )
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self._rows: collections.deque = collections.deque(maxlen=window)
+        self._events: collections.deque = collections.deque(maxlen=window)
+        self._registry = registry if registry is not None else telemetry
+        self._context = dict(context or {})
+        self._lock = threading.Lock()
+        self._last_nonfinite: int | None = None  # set by first observe_step
+        self._last_skipped: int | None = None
+        self._n_dumps = 0
+        # per-trigger-kind cap: a run that goes permanently non-finite
+        # must not write one dump per step for the rest of the run — the
+        # first few carry the diagnostic value, the rest are disk burn
+        self._max_per_trigger = max_dumps_per_trigger
+        self._per_trigger: dict[str, int] = {}
+        self.suppressed: dict[str, int] = {}
+        self.dumps: list[str] = []
+
+    # -- feeding the buffer ------------------------------------------------
+
+    def record(self, step: int, **metrics: Any) -> None:
+        """Append one metric row (host-coerced scalars) to the window."""
+        row = {"step": int(step), "time": round(time.time(), 3)}
+        for key, val in metrics.items():
+            row[key] = _to_scalar(val)
+        with self._lock:
+            self._rows.append(row)
+
+    def note_event(self, kind: str, **fields: Any) -> None:
+        """Append a host-side event (checkpoint saved, lr change) to the
+        window without going through the global registry."""
+        with self._lock:
+            self._events.append(
+                {"event": kind, "time": round(time.time(), 3), **fields}
+            )
+
+    def observe_step(self, step: int, metrics: "TrainMetrics") -> str | None:
+        """Record this step's :class:`TrainMetrics` row and dump when it
+        shows trouble: the step was skipped, or the nonfinite counter
+        advanced (unguarded runs — the update was applied anyway).
+
+        Reading the metrics forces a device sync; call at your logging
+        cadence, or per step in loops that already block each step.
+        Returns the dump path when a dump was triggered, else None.
+        """
+        row = {
+            "loss": _to_scalar(metrics.loss),
+            "grad_norm": _to_scalar(metrics.grad_norm),
+            "step_ok": bool(metrics.step_ok),
+            "skipped": int(metrics.skipped),
+            "nonfinite": int(metrics.nonfinite),
+        }
+        self.record(step, **row)
+        # watermarks seed from the FIRST observed row: a resumed run
+        # whose checkpoint carried nonzero skipped/nonfinite counters
+        # must not false-alarm on its first healthy step (step_ok still
+        # catches a genuinely-bad first step)
+        if self._last_skipped is None:
+            self._last_skipped = row["skipped"]
+            self._last_nonfinite = row["nonfinite"]
+        trigger = None
+        if row["skipped"] > self._last_skipped or not row["step_ok"]:
+            trigger = "nonfinite_skip"
+        elif row["nonfinite"] > self._last_nonfinite:
+            trigger = "nonfinite_applied"
+        self._last_skipped = row["skipped"]
+        self._last_nonfinite = row["nonfinite"]
+        if trigger is None:
+            return None
+        return self.dump(trigger, step=step, loss=row["loss"],
+                         grad_norm=row["grad_norm"])
+
+    # -- triggers ----------------------------------------------------------
+
+    def dump(self, trigger: str, **detail: Any) -> str | None:
+        """Write the window to ``flight_NNN_<trigger>.json`` atomically and
+        return the path; ``None`` when nothing was written — either the
+        write failed (never raises: a full disk must not mask the
+        original fault; the failure lands as an event row in the next
+        dump) or this trigger kind already hit ``max_dumps_per_trigger``
+        (``suppressed`` counts what was withheld)."""
+        with self._lock:
+            count = self._per_trigger.get(trigger, 0)
+            if self._max_per_trigger and count >= self._max_per_trigger:
+                if trigger not in self.suppressed:
+                    self._events.append({
+                        "event": "flight_dumps_capped", "trigger": trigger,
+                        "limit": self._max_per_trigger,
+                        "time": round(time.time(), 3),
+                    })
+                self.suppressed[trigger] = self.suppressed.get(trigger, 0) + 1
+                return None
+            self._per_trigger[trigger] = count + 1
+            self._n_dumps += 1
+            payload = {
+                "schema": FLIGHT_SCHEMA_VERSION,
+                "trigger": {
+                    "kind": trigger,
+                    "time": round(time.time(), 3),
+                    **{k: _to_scalar(v) for k, v in detail.items()},
+                },
+                "context": dict(self._context),
+                "rows": list(self._rows),
+                "events": list(self._events)
+                + list(self._registry.events()),
+            }
+            safe = "".join(
+                c if c.isalnum() or c in "-_" else "_" for c in trigger
+            )[:40]
+            path = os.path.join(
+                self.directory, f"flight_{self._n_dumps:03d}_{safe}.json"
+            )
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError as e:
+            # return None, not the path: a caller printing "dump at X"
+            # for a file that was never written sends the operator
+            # chasing a ghost.  The cap slot is refunded — N failed
+            # writes (disk briefly full) must not silence the trigger
+            # kind for the rest of the run.
+            with self._lock:
+                self._per_trigger[trigger] -= 1
+            self.note_event("flight_dump_failed", path=path, error=str(e))
+            return None
+        with self._lock:
+            self.dumps.append(path)
+        return path
+
+    @contextlib.contextmanager
+    def guard(self, label: str = "train_loop") -> Iterator["FlightRecorder"]:
+        """Dump on any escaping exception (``RetraceError``, OOM, a bug),
+        then re-raise — the crash arrives with its trajectory attached."""
+        try:
+            yield self
+        except BaseException as e:
+            self.dump(
+                "crash", label=label,
+                error=f"{type(e).__name__}: {e}"[:500],
+            )
+            raise
+
+    def install(self) -> "FlightRecorder":
+        """Wire the automatic host-side triggers: every kernel degradation
+        and every exhausted ``with_retries`` ladder dumps the window.
+        Idempotent; returns self for chaining.  The registries are
+        process-global — call :meth:`uninstall` when the recorder's run
+        ends before the process does (tests, config sweeps), or dead
+        recorders keep dumping into stale directories forever."""
+        try:
+            from . import resilience
+        except ImportError:  # standalone file-path load
+            return self
+        resilience.degradation.add_listener(self._on_degraded)
+        resilience.add_failure_listener(self._on_retry_exhausted)
+        return self
+
+    def uninstall(self) -> "FlightRecorder":
+        """Detach the :meth:`install` listeners (no-op if never
+        installed)."""
+        try:
+            from . import resilience
+        except ImportError:
+            return self
+        resilience.degradation.remove_listener(self._on_degraded)
+        resilience.remove_failure_listener(self._on_retry_exhausted)
+        return self
+
+    def _on_degraded(self, component: str, reason: str) -> None:
+        self.dump("degraded", component=component, reason=reason)
+
+    def _on_retry_exhausted(self, where: str, error: str) -> None:
+        self.dump("retry_exhausted", where=where, error=error)
+
+
+def read_flight_dump(path: str) -> dict[str, Any]:
+    """Parse one flight dump, with a loud error naming an unknown schema
+    (forward-compat: readers must not silently misread a v2 dump)."""
+    with open(path) as f:
+        payload = json.load(f)
+    schema = payload.get("schema")
+    if schema != FLIGHT_SCHEMA_VERSION:
+        raise ValueError(
+            f"read_flight_dump: {path} has schema {schema!r}; this reader "
+            f"understands {FLIGHT_SCHEMA_VERSION}"
+        )
+    return payload
 
 
 # ----------------------------------------------------------------------

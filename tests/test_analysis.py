@@ -343,6 +343,41 @@ def test_lint_toy_violations_each_rule():
     assert rules == ["RA003", "RA004", "RA005", "RA006", "RA007"]
 
 
+def test_lint_ra008_observe_guard_and_unit_suffix():
+    """RA008: a library-level ``Telemetry.observe`` outside a
+    ``collecting()`` block silently drops its scalar; an unsuffixed
+    metric name has no unit.  Both flag; the guarded, suffixed form and
+    the reasoned allow are clean."""
+    bad = textwrap.dedent("""
+        from ring_attention_tpu.utils.telemetry import telemetry
+
+        def f(x):
+            telemetry.observe("kv_hop", x)
+            return x
+    """)
+    violations = lint_source(bad, "ring_attention_tpu/parallel/toy.py")
+    assert [v.rule for v in violations] == ["RA008", "RA008"]
+    assert any("collecting()" in v.message for v in violations)
+    assert any("unit" in v.message for v in violations)
+    good = textwrap.dedent("""
+        from ring_attention_tpu.utils.telemetry import telemetry
+
+        def f(x):
+            with telemetry.collecting() as col:
+                telemetry.observe("kv_hop_bytes", x)
+            return x, col.values()
+    """)
+    assert lint_source(good, "ring_attention_tpu/parallel/toy.py") == []
+    allowed = textwrap.dedent("""
+        from ring_attention_tpu.utils.telemetry import telemetry
+
+        def f(x):
+            telemetry.observe("kv_hop", x)  # ra: allow(RA008 collected by caller at this trace level; name pinned by dashboard)
+            return x
+    """)
+    assert lint_source(allowed, "ring_attention_tpu/parallel/toy.py") == []
+
+
 def test_lint_pragma_silences_with_reason():
     src = 'from jax import lax\n' \
           'def f(x):\n' \

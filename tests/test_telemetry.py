@@ -273,6 +273,19 @@ def test_flash_scope_names_in_profiler_trace(tmp_path):
                 blobs.append(open(os.path.join(root, name), "rb").read())
     assert blobs, "profiler produced no xplane capture"
     assert any(b"flash/fwd" in blob for blob in blobs)
+    # and the observatory's stdlib parser resolves the same capture into
+    # a stage timeline (the per-hop/ring assertions live in
+    # tests/test_observatory.py; this pins the single-device join)
+    from ring_attention_tpu.utils.profiling import (
+        read_xplane_events,
+        stage_timeline,
+    )
+
+    events, note = read_xplane_events(str(tmp_path))
+    assert events, f"stdlib xplane parser found no events: {note}"
+    rows = stage_timeline(events)["stages"]
+    flash = [r for r in rows if r["stage"] == "flash forward kernel"]
+    assert flash and flash[0]["busy_ms"] > 0
 
 
 def test_ring_scope_names_in_compiled_hlo(rng, devices):

@@ -26,6 +26,7 @@ WATCHER = os.path.join(REPO, "tools", "tpu_window_watch.sh")
 KERNEL_VALIDATE = os.path.join(REPO, "tools", "tpu_kernel_validate.py")
 TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
 CHECK_CONTRACTS = os.path.join(REPO, "tools", "check_contracts.py")
+PERF_GATE = os.path.join(REPO, "tools", "perf_gate.py")
 
 
 def test_tools_exist():
@@ -108,6 +109,72 @@ def test_trace_report_flags_parse():
     assert "--last" in proc.stdout
 
 
+def test_trace_report_diff_renders(tmp_path):
+    """``--diff OLD NEW`` — the human-facing half of the perf gate — must
+    produce the side-by-side delta/percent table from two metrics runs
+    (stdlib-only, no jax import)."""
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    for d, tps in ((old, 100.0), (new, 80.0)):
+        d.mkdir()
+        (d / "metrics.jsonl").write_text(
+            f'{{"schema": 1, "step": 0, "loss": 2.0, '
+            f'"tokens_per_sec": {tps}}}\n'
+        )
+    proc = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--diff", str(old), str(new)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "tokens_per_sec" in proc.stdout
+    assert "-20.0%" in proc.stdout
+    assert "pct" in proc.stdout
+
+
+def test_perf_gate_compiles():
+    py_compile.compile(PERF_GATE, doraise=True)
+
+
+def test_perf_gate_flags_parse():
+    proc = subprocess.run(
+        [sys.executable, PERF_GATE, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    for flag in ("--check", "--json", "--history-only", "--update-baseline",
+                 "--strategies", "--skip-compiled"):
+        assert flag in proc.stdout, f"{flag} missing from --help"
+
+
+def test_perf_gate_refuses_subset_baseline():
+    """``--update-baseline`` from a subset run would silently drop the
+    missing signal families (absent baseline families are notes, not
+    findings) — the CLI must refuse before collecting anything."""
+    proc = subprocess.run(
+        [sys.executable, PERF_GATE, "--update-baseline", "--skip-compiled"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "full signal set" in proc.stderr
+
+
+def test_perf_gate_check_json_smoke():
+    """``--check --json`` on the real repo history, history-only (no
+    compiles — the live-signal gate runs in tests/test_observatory.py):
+    one valid JSON object, ok verdict, wedge record present."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, PERF_GATE, "--check", "--history-only", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True
+    assert report["gate_schema"] >= 1
+    assert any("wedge record" in n for n in report["notes"])
+
+
 def test_check_contracts_compiles():
     py_compile.compile(CHECK_CONTRACTS, doraise=True)
 
@@ -165,7 +232,7 @@ def test_check_contracts_mesh_mismatch_is_a_diagnostic():
 def test_repo_lint_self_run():
     """The repo lint over the package tree exits clean — the python
     analogue of ``bash -n``: every one-liner fix that landed with rules
-    RA001-RA007 stays landed.  Run in the script-path form, which is the
+    RA001-RA008 stays landed.  Run in the script-path form, which is the
     documented jax-free invocation (the ``-m`` form imports the package
     ``__init__`` chain and therefore jax)."""
     proc = subprocess.run(

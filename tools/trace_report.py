@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Render a telemetry run into per-metric and per-stage tables.
+"""Render a telemetry run into per-metric, per-stage, and per-hop tables.
 
 Input is a metrics directory (or JSONL file) written by
 ``examples/train.py --metrics-dir`` / ``MetricsLogger``
@@ -11,25 +11,38 @@ XProf capture directory (``tools/xprof_capture.py`` / ``utils.profiling
 - per-metric table (last / mean / p50 / p95) over the numeric metric
   columns — loss, grad_norm, tokens_per_sec, step latency, mfu;
 - comms accounting echo (ring hops, bytes per hop, overlap fraction);
-- when ``--xprof DIR`` points at a capture with ``*.xplane.pb`` planes, a
-  per-stage device-time table keyed on the stack's stable trace names
-  (``ring/hop*``, ``ulysses/*``, ``hybrid/*``, ``flash*``,
-  ``tree_decode/*``) — where the step's wall time actually went.
+- when ``--xprof DIR`` points at a capture with ``*.xplane.pb`` planes:
+  the per-stage device-time table (busy ms / share / p50 / p95 keyed on
+  the stack's stable trace names), the per-hop compute-vs-transfer
+  timeline, and the MEASURED compute/transfer overlap fraction — printed
+  next to the analytic ``hop_overlap_fraction`` from the metrics rows
+  when both exist; disagreement beyond ``--overlap-tolerance`` is
+  reported as a FINDING line (the comms model no longer describes the
+  capture);
+- ``--diff OLD NEW`` (instead of a single run): side-by-side per-metric
+  table over two runs with delta and percent columns — the manual
+  version of ``tools/perf_gate.py`` for a human bisecting a regression.
 
-Stdlib-only except the optional xplane proto parser (the same
-best-effort import as ``tools/xprof_capture.py``); parsing never fails
-the report.  Usage::
+Stdlib-only: the xplane parser is ``utils/profiling.py``'s wire-format
+reader (loaded by file path, no jax import), so this tool runs on a box
+where jax cannot.  Usage::
 
-  python tools/trace_report.py /tmp/m [--xprof docs/hwlogs/xprof/train]
+  python tools/trace_report.py /tmp/m [--xprof /tmp/profile]
+  python tools/trace_report.py --diff /tmp/m_before /tmp/m_after
 """
 
 from __future__ import annotations
 
 import argparse
-import glob
+import importlib.util
 import os
 import sys
 from collections import defaultdict
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG_UTILS = os.path.join(
+    os.path.dirname(_HERE), "ring_attention_tpu", "utils"
+)
 
 # metric columns the table summarizes, in display order (other numeric
 # fields are appended alphabetically)
@@ -68,55 +81,38 @@ ACCOUNTING = [
     "host_output_bytes",
 ]
 
-# stage buckets for the xprof table, keyed on the stable scope/kernel
-# names threaded through parallel/ and ops/ (docs/observability.md)
-STAGES = [
-    ("ring/hop", "ring hop compute"),
-    ("ring/rotate", "ring kv rotation"),
-    ("ring/bwd", "ring backward"),
-    ("ring/catchup", "ring dkv catch-up"),
-    ("ulysses/a2a", "ulysses all-to-all"),
-    ("ulysses/flash", "ulysses local flash"),
-    ("hybrid/a2a", "hybrid all-to-all"),
-    ("hybrid/inner", "hybrid inner ring"),
-    ("zigzag/", "zigzag"),
-    ("tree_decode/gather", "tree-decode merge"),
-    ("tree_decode/", "tree-decode local"),
-    ("flash_bwd", "flash backward kernel"),  # pallas kernel name
-    ("flash/bwd", "flash backward"),  # XLA-path named_scope
-    ("flash_decode", "flash decode kernel"),
-    ("flash", "flash forward kernel"),
-]
 
-
-def _read_rows(path: str) -> list[dict]:
-    """The library's own reader (``telemetry.read_metrics`` — the one the
-    killed-writer tests pin), loaded by file path so this tool never
-    imports the package (whose ``__init__`` pulls in jax/flax)."""
-    import importlib.util
-
+def _load_module(name: str, filename: str):
+    """Load a utils module by file path so this tool never imports the
+    package (whose ``__init__`` pulls in jax/flax) — the same pattern as
+    ``bench.py``'s parent process; both modules are stdlib-only at module
+    level by design.  Memoized: one exec per module per run."""
+    if name in sys.modules:
+        return sys.modules[name]
     spec = importlib.util.spec_from_file_location(
-        "_report_telemetry",
-        os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "ring_attention_tpu", "utils", "telemetry.py",
-        ),
+        name, os.path.join(_PKG_UTILS, filename)
     )
     mod = importlib.util.module_from_spec(spec)
     sys.modules[spec.name] = mod
     spec.loader.exec_module(mod)
-    return mod.read_metrics(path)
+    return mod
+
+
+def _read_rows(path: str) -> list[dict]:
+    """The library's own reader (``telemetry.read_metrics`` — the one the
+    killed-writer tests pin)."""
+    return _load_module("_report_telemetry", "telemetry.py").read_metrics(path)
+
+
+def _profiling():
+    return _load_module("_report_profiling", "profiling.py")
 
 
 def _percentile(values: list[float], q: float) -> float:
-    if not values:
-        return 0.0
-    values = sorted(values)
-    pos = q * (len(values) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(values) - 1)
-    frac = pos - lo
-    return values[lo] * (1 - frac) + values[hi] * frac
+    """The library's own percentile (``profiling.percentile`` — the one
+    the timer and the timeline use), so the three tables can never
+    disagree on interpolation."""
+    return _profiling().percentile(values, q)
 
 
 def _fmt(x: float) -> str:
@@ -125,6 +121,19 @@ def _fmt(x: float) -> str:
     if abs(x) >= 1e5 or abs(x) < 1e-3:
         return f"{x:.3e}"
     return f"{x:,.4f}".rstrip("0").rstrip(".")
+
+
+def _numeric_columns(rows: list[dict]) -> dict[str, list[float]]:
+    numeric: dict[str, list[float]] = defaultdict(list)
+    for r in rows:
+        if "event" in r:
+            continue
+        for key, val in r.items():
+            if key in ("schema", "step", "time") or isinstance(val, bool):
+                continue
+            if isinstance(val, (int, float)):
+                numeric[key].append(float(val))
+    return numeric
 
 
 def metrics_report(rows: list[dict], out: list[str]) -> None:
@@ -148,14 +157,7 @@ def metrics_report(rows: list[dict], out: list[str]) -> None:
     if not metric_rows:
         return
 
-    numeric: dict[str, list[float]] = defaultdict(list)
-    for r in metric_rows:
-        for key, val in r.items():
-            if key in ("schema", "step", "time") or isinstance(val, bool):
-                continue
-            if isinstance(val, (int, float)):
-                numeric[key].append(float(val))
-
+    numeric = _numeric_columns(rows)
     acct = [k for k in ACCOUNTING if k in numeric]
     if acct:
         out.append("")
@@ -178,87 +180,142 @@ def metrics_report(rows: list[dict], out: list[str]) -> None:
         )
 
 
-def _stage_of(op_name: str) -> str | None:
-    n = op_name.lower()
-    for needle, label in STAGES:
-        if needle in n:
-            return label
-    return None
+def diff_report(old_path: str, new_path: str, out: list[str]) -> None:
+    """Side-by-side per-metric comparison of two runs: p50 over each run
+    plus delta and percent — the human-facing half of the perf gate."""
+    old = _numeric_columns(_read_rows(old_path))
+    new = _numeric_columns(_read_rows(new_path))
+    out.append(f"diff: OLD={old_path}  NEW={new_path}")
+    keys = [k for k in PREFERRED if k in old or k in new]
+    keys += sorted((set(old) | set(new)) - set(keys))
+    out.append("")
+    out.append(f"  {'metric':24s} {'old p50':>12s} {'new p50':>12s} "
+               f"{'delta':>12s} {'pct':>8s}")
+    for key in keys:
+        a = _percentile(old[key], 0.5) if key in old else None
+        b = _percentile(new[key], 0.5) if key in new else None
+        if a is None or b is None:
+            side = "only OLD" if b is None else "only NEW"
+            old_s = _fmt(a) if a is not None else "-"
+            new_s = _fmt(b) if b is not None else "-"
+            out.append(f"  {key:24s} {old_s:>12s} {new_s:>12s} "
+                       f"{side:>12s} {'-':>8s}")
+            continue
+        delta = b - a
+        pct = f"{delta / a * 100:+.1f}%" if a else "-"
+        out.append(
+            f"  {key:24s} {_fmt(a):>12s} {_fmt(b):>12s} "
+            f"{_fmt(delta):>12s} {pct:>8s}"
+        )
 
 
-def xprof_report(trace_dir: str, out: list[str]) -> None:
-    """Per-stage device time from an xplane capture, keyed on the stable
-    scope names.  Best-effort: a missing proto parser or an empty capture
-    degrades to a note, never an error (the metrics table above is the
-    primary product)."""
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception as e:  # ImportError or any TF-init failure
-        out.append(f"[xprof] parser unavailable ({type(e).__name__}); "
-                   f"traces under {trace_dir} — parse offline")
+def xprof_report(trace_dir: str, out: list[str], *,
+                 analytic: float | None = None,
+                 tolerance: float = 0.25,
+                 ring_size: int | None = None) -> None:
+    """Per-stage/per-hop device time + measured overlap from an xplane
+    capture, via the stdlib parser in ``utils/profiling.py``.
+    ``ring_size`` (from the run's accounting rows) folds multi-step
+    captures into per-step hop samples.  Best-effort: an unreadable
+    capture degrades to a note, never an error (the metrics table above
+    is the primary product)."""
+    prof = _profiling()
+    report = prof.overlap_report(trace_dir, analytic=analytic,
+                                 tolerance=tolerance, ring_size=ring_size)
+    if "note" in report:
+        out.append(f"[xprof] {report['note']}")
         return
-    paths = glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    )
-    if not paths:
-        out.append(f"[xprof] no .xplane.pb under {trace_dir}")
-        return
-    space = xplane_pb2.XSpace()
-    with open(max(paths, key=os.path.getmtime), "rb") as f:
-        space.ParseFromString(f.read())
-    planes = [
-        p for p in space.planes if "TPU" in p.name or "/device:" in p.name
-    ] or list(space.planes)
-    per_stage: dict[str, float] = defaultdict(float)
-    total = 0.0
-    for plane in planes:
-        op_lines = [l for l in plane.lines if "XLA Ops" in l.name]
-        for line in op_lines or plane.lines:
-            for ev in line.events:
-                meta = plane.event_metadata.get(ev.metadata_id)
-                name = meta.name if meta else ""
-                # scope names ride the op's display name or its metadata
-                label = _stage_of(name) or _stage_of(
-                    getattr(meta, "display_name", "") if meta else ""
-                )
-                ms = ev.duration_ps / 1e9
-                total += ms
-                per_stage[label or "other"] += ms
-    if not total:
-        out.append(f"[xprof] no events parsed under {trace_dir}")
-        return
+    timeline = report["timeline"]
+    total = timeline["total_busy_ms"] or 1.0
     out.append("")
     out.append(f"per-stage device time ({trace_dir})")
-    out.append(f"  {'stage':28s} {'ms':>10s} {'share':>7s}")
-    for label, ms in sorted(per_stage.items(), key=lambda kv: -kv[1]):
-        out.append(f"  {label:28s} {ms:10.3f} {100 * ms / total:6.1f}%")
+    out.append(f"  {'stage':26s} {'kind':>8s} {'busy ms':>10s} "
+               f"{'share':>7s} {'p50 ms':>9s} {'p95 ms':>9s}")
+    for row in timeline["stages"]:
+        out.append(
+            f"  {row['stage']:26s} {row['kind']:>8s} "
+            f"{row['busy_ms']:10.3f} {100 * row['busy_ms'] / total:6.1f}% "
+            f"{row['p50_ms']:9.3f} {row['p95_ms']:9.3f}"
+        )
+    if timeline["hops"]:
+        out.append("")
+        out.append("per-hop timeline (ring schedule)")
+        out.append(f"  {'hop':>4s} {'compute ms':>11s} {'transfer ms':>12s} "
+                   f"{'samples':>8s}")
+        for row in timeline["hops"]:
+            out.append(
+                f"  {row['hop']:4d} {row['compute_ms']:11.3f} "
+                f"{row['transfer_ms']:12.3f} {row['samples']:8d}"
+            )
+    out.append("")
+    out.append(
+        f"measured overlap: {report['overlap_fraction']:.3f} "
+        f"(transfer {report['transfer_ms']:.3f} ms, compute "
+        f"{report['compute_ms']:.3f} ms, overlapped "
+        f"{report['overlapped_ms']:.3f} ms)"
+    )
+    if "analytic_overlap_fraction" in report:
+        out.append(
+            f"analytic overlap: {report['analytic_overlap_fraction']:.3f} "
+            f"(ring_comms_accounting hop_overlap_fraction)"
+        )
+        if not report["agrees"]:
+            out.append(f"FINDING: {report['finding']}")
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="Render telemetry JSONL (+ optional xprof capture) "
-                    "into per-metric / per-stage tables"
+                    "into per-metric / per-stage / per-hop tables"
     )
-    ap.add_argument("metrics",
+    ap.add_argument("metrics", nargs="?", default=None,
                     help="metrics directory (holding metrics.jsonl) or a "
                          "JSONL file written by MetricsLogger")
     ap.add_argument("--xprof", default=None,
                     help="xprof capture dir (tools/xprof_capture.py / "
-                         "utils.profiling.trace): adds a per-stage device-"
-                         "time table keyed on the stable trace names")
+                         "utils.profiling.trace): adds per-stage and "
+                         "per-hop device-time tables plus the measured "
+                         "compute/transfer overlap fraction")
     ap.add_argument("--last", type=int, default=None,
                     help="summarize only the last N metric rows")
+    ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"), default=None,
+                    help="compare two metrics runs: per-metric p50 "
+                         "side-by-side with delta and percent columns")
+    ap.add_argument("--overlap-tolerance", type=float, default=0.25,
+                    help="measured-vs-analytic overlap disagreement beyond "
+                         "this is reported as a FINDING (default 0.25)")
     args = ap.parse_args(argv)
+
+    out: list[str] = []
+    if args.diff:
+        diff_report(args.diff[0], args.diff[1], out)
+        print("\n".join(out))
+        return 0
+    if args.metrics is None:
+        ap.error("metrics path required (or use --diff OLD NEW)")
 
     rows = _read_rows(args.metrics)
     if args.last is not None:
         events = [r for r in rows if "event" in r]
         metric = [r for r in rows if "event" not in r][-args.last:]
         rows = events + metric
-    out: list[str] = [f"trace report: {args.metrics}"]
+    out.append(f"trace report: {args.metrics}")
     metrics_report(rows, out)
     if args.xprof:
-        xprof_report(args.xprof, out)
+        # analytic overlap + ring size from the run's own accounting
+        # rows, when present
+        numeric = _numeric_columns(rows)
+        analytic = (
+            numeric["hop_overlap_fraction"][-1]
+            if numeric.get("hop_overlap_fraction") else None
+        )
+        ring_size = (
+            int(numeric["ring_size"][-1])
+            if numeric.get("ring_size") else None
+        )
+        xprof_report(args.xprof, out, analytic=analytic,
+                     tolerance=args.overlap_tolerance,
+                     ring_size=ring_size)
     print("\n".join(out))
     return 0
 
