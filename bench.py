@@ -207,6 +207,20 @@ def _fingerprint_worker() -> None:
     print(json.dumps(collective_fingerprint()))
 
 
+def _coverage_worker() -> None:
+    """Tile-coverage fingerprint (``analysis/coverage.py``): per-row
+    compact-grid tile counts from the coverage prover, next to the
+    collective fingerprint in the bench JSON — a mask/hint change that
+    starts visiting dead tiles (or dropping live ones) shows up as a
+    fingerprint diff in the perf trajectory even on wedged-TPU rounds.
+    Pure numpy + trace-time helpers: no devices, no compiles."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from ring_attention_tpu.analysis.coverage import coverage_fingerprint
+
+    print(json.dumps(coverage_fingerprint()))
+
+
 def _train1m_mem_worker(extra: dict) -> None:
     """CPU-provable half of the ``train1m`` phase: the memory claim.
 
@@ -1224,6 +1238,17 @@ def main() -> None:
     else:
         result["collective_fingerprint"] = {"error": (fp_err or "failed")[-200:]}
 
+    # phase 0b — tile-coverage fingerprint (numpy-only, rides the same
+    # pre-probe slot): per-row compact-grid tile counts, gated exactly in
+    # analysis/perfgate.py next to the collective counts
+    cov, cov_err = _run_attempt(
+        "cpu", 0, "coverage", float(os.environ.get("BENCH_COV_BUDGET_S", 180))
+    )
+    if cov is not None:
+        result["coverage_fingerprint"] = cov
+    else:
+        result["coverage_fingerprint"] = {"error": (cov_err or "failed")[-200:]}
+
     # phase 0c — train1m memory proof (CPU-only, pre-probe like the
     # fingerprint): chunked-vs-dense compiled peak temp bytes at equal
     # shape + the analytic 2^20-token peak-HBM estimate, so the
@@ -1539,6 +1564,8 @@ if __name__ == "__main__":
         if mode == "fingerprint":
             # env setup must precede the first jax import (see the worker)
             _fingerprint_worker()
+        elif mode == "coverage":
+            _coverage_worker()
         elif mode == "train1m_mem":
             # likewise CPU-forced before the first jax import
             _train1m_mem_worker(extra)

@@ -1,30 +1,48 @@
 """Static-analysis subsystem: machine-checked contracts for the package.
 
-Three passes, all CPU-runnable in tier-1 (see docs/static_analysis.md):
+The passes, all CPU-runnable in tier-1 (see docs/static_analysis.md):
 
   - :mod:`~ring_attention_tpu.analysis.contracts` — declarative
     collective/HLO contracts per sequence-parallel strategy, verified
     against optimized HLO and jaxpr structure;
   - :mod:`~ring_attention_tpu.analysis.lint` — repo-native AST lint
     (compat-shim bypasses, unnamed kernels, unscoped collectives, host
-    entropy in traced code, unvalidated entry points);
+    entropy/numpy in traced code, unvalidated entry points);
   - :mod:`~ring_attention_tpu.analysis.recompile` — retrace sentinel
     (each entry point compiles exactly once per shape) and the f32
-    accumulator-dtype audit;
+    accumulator-dtype spot audit;
+  - :mod:`~ring_attention_tpu.analysis.dataflow` — jaxpr abstract
+    interpretation: the precision-flow auditor (bf16/int8 taint to every
+    reduction/accumulator, generalizing the spot audit) and the SPMD
+    divergence checker (branch-invariant collective sequences);
+  - :mod:`~ring_attention_tpu.analysis.coverage` — the tile-coverage
+    prover: the compact skip grids held to a global-position oracle for
+    soundness (no live tile skipped), tightness (no dead tile visited),
+    and schedule completeness, per strategy x layout x masking row;
   - :mod:`~ring_attention_tpu.analysis.perfgate` — the perf-observatory
     regression gate: BENCH_r*.json / hwlog history ingest + CPU-signal
     checks against ``docs/perf_baseline.json`` (wedge-honest: rounds
     whose TPU probe never ran are recorded, never silently passed).
 
-CLI: ``tools/check_contracts.py`` (full contract suite),
-``tools/perf_gate.py`` (the regression gate), and
-``python -m ring_attention_tpu.analysis`` (lint + dtype audit +
+CLI: ``tools/check_contracts.py`` (contract suite; ``--coverage`` /
+``--dataflow`` for the prover and jaxpr audits), ``tools/perf_gate.py``
+(the regression gate), and ``python -m ring_attention_tpu.analysis``
+(lint + dtype audit + precision flow + divergence + coverage +
 compile-free gate self-run).
 On a host without jax, run the lint as a plain script —
 ``python ring_attention_tpu/analysis/lint.py`` — which skips this
 package ``__init__`` chain entirely.
 """
 
+from .dataflow import (
+    JaxprWalker,
+    PrecisionFlow,
+    audit_precision_flow,
+    check_spmd_divergence,
+    collective_signature,
+    run_divergence_suite,
+    run_precision_suite,
+)
 from .lint import Violation, lint_file, lint_package, lint_source
 from .perfgate import (
     GATE_SCHEMA_VERSION,
@@ -52,11 +70,18 @@ __all__ = [
     "GateFinding",
     "GateReport",
     "History",
+    "JaxprWalker",
+    "PrecisionFlow",
     "RetraceError",
     "Violation",
+    "audit_precision_flow",
+    "check_spmd_divergence",
     "collect_current",
+    "collective_signature",
     "load_history",
+    "run_divergence_suite",
     "run_gate",
+    "run_precision_suite",
     "write_baseline",
     "assert_compiles_once",
     "audit_accumulator_dtypes",
@@ -66,14 +91,16 @@ __all__ = [
     "lint_file",
     "lint_package",
     "lint_source",
-    # contracts is imported lazily (it pulls in jax + the parallel stack):
+    # imported lazily (contracts pulls in jax + the parallel stack;
+    # coverage pulls the kernel module for band_plan):
     "contracts",
+    "coverage",
 ]
 
 
 def __getattr__(name: str):
-    if name == "contracts":
+    if name in ("contracts", "coverage"):
         import importlib
 
-        return importlib.import_module(".contracts", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)

@@ -37,6 +37,15 @@ past PR, with the shim/convention that prevents it:
          a library-level call outside any ``collecting()`` silently drops
          every scalar it claims to record; and an unsuffixed name
          ("kv_hop") reads as whatever unit the dashboard author guesses.
+  RA009  host ``np.`` / ``numpy.`` calls in traced-code subpackages.  A
+         numpy function applied to a traced value either raises a
+         TracerArrayConversionError deep in the call or silently
+         constant-folds at trace time (the jaxpr then carries a baked-in
+         literal — visible to ``analysis/dataflow.py``'s walker as a
+         constant where an operation should be); a numpy call on
+         genuinely static trace-time data (device topology, tile tables)
+         is legitimate and carries a reasoned allow.  ``np.random.*``
+         stays RA005's.
 
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
@@ -208,6 +217,12 @@ class _Linter(ast.NodeVisitor):
                 self.flag(node, "RA005",
                           f"host RNG {chain}() in traced code — constant "
                           "after trace; use jax.random with an explicit key")
+            elif chain.startswith(("np.", "numpy.")):
+                self.flag(node, "RA009",
+                          f"host numpy {chain}() in traced code — on a "
+                          "traced value this raises or silently constant-"
+                          "folds at trace time; use jnp, or allow with a "
+                          "reason for provably static trace-time data")
 
         if (name == "print" and isinstance(func, ast.Name)
                 and not self.rel.endswith("__main__.py")):  # __main__ IS a CLI
@@ -326,7 +341,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA008)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA009)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

@@ -172,6 +172,15 @@ class BenchRound:
             return {k: v for k, v in fp.items() if k != "gate_schema"}
         return None
 
+    @property
+    def coverage(self) -> dict[str, Any] | None:
+        """The tile-coverage fingerprint (bench phase 0b): per-row
+        compact-grid tile counts from ``analysis/coverage.py``."""
+        fp = self.payload.get("coverage_fingerprint")
+        if isinstance(fp, dict) and "error" not in fp:
+            return {k: v for k, v in fp.items() if k != "gate_schema"}
+        return None
+
 
 @dataclass
 class History:
@@ -340,13 +349,15 @@ def collect_current(
         "blockwise_ffn",
     ),
     compiled: bool = True,
+    coverage: bool = True,
 ) -> dict[str, Any]:
     """The current build's CPU gate signals.
 
     ``strategies=None`` skips the (compile-paying) fingerprint;
     ``compiled=False`` skips the reference-step compile — the arithmetic
-    comms table always lands.  Each skipped family is simply absent, and
-    :func:`check` notes absent families instead of passing them silently.
+    comms table and the (numpy-only) tile-coverage fingerprint always
+    land.  Each skipped family is simply absent, and :func:`check` notes
+    absent families instead of passing them silently.
     """
     import jax
 
@@ -355,6 +366,10 @@ def collect_current(
         "jax": jax.__version__,
         "comms": comms_reference_signals(),
     }
+    if coverage:
+        from .coverage import coverage_fingerprint
+
+        signals["coverage"] = coverage_fingerprint()
     if strategies:
         from .contracts import collective_fingerprint
 
@@ -401,7 +416,7 @@ def check_baseline(
     base_signals = baseline.get("signals", baseline)
 
     # exact families -----------------------------------------------------
-    for family in ("fingerprint", "comms"):
+    for family in ("fingerprint", "comms", "coverage"):
         base = base_signals.get(family)
         cur = current.get(family)
         if base is None:
@@ -559,19 +574,21 @@ def check_history(
                     f"{abs(drop):.1%} > {limit:.0%} tolerance)",
                 ))
     # fingerprint drift between consecutive carrying rounds ---------------
-    fps = [(r.number, r.fingerprint) for r in history.rounds
-           if r.fingerprint is not None]
-    for (n0, fp0), (n1, fp1) in zip(fps, fps[1:]):
-        flat0 = _flat(fp0, "fingerprint")
-        flat1 = _flat(fp1, "fingerprint")
-        for series in sorted(set(flat0) & set(flat1)):
-            report.checked.append(f"{series}[r{n0}->r{n1}]")
-            if flat0[series] != flat1[series]:
-                report.findings.append(GateFinding(
-                    series, flat0[series], flat1[series],
-                    f"drift r{n0} -> r{n1}: {flat0[series]} -> "
-                    f"{flat1[series]}",
-                ))
+    for family, getter in (("fingerprint", lambda r: r.fingerprint),
+                           ("coverage", lambda r: r.coverage)):
+        fps = [(r.number, getter(r)) for r in history.rounds
+               if getter(r) is not None]
+        for (n0, fp0), (n1, fp1) in zip(fps, fps[1:]):
+            flat0 = _flat(fp0, family)
+            flat1 = _flat(fp1, family)
+            for series in sorted(set(flat0) & set(flat1)):
+                report.checked.append(f"{series}[r{n0}->r{n1}]")
+                if flat0[series] != flat1[series]:
+                    report.findings.append(GateFinding(
+                        series, flat0[series], flat1[series],
+                        f"drift r{n0} -> r{n1}: {flat0[series]} -> "
+                        f"{flat1[series]}",
+                    ))
     return report
 
 
@@ -589,7 +606,7 @@ def _downgrade_acknowledged_drift(
     """
     acknowledged = {
         s for s in baseline_report.checked
-        if s.startswith("fingerprint.")
+        if s.startswith(("fingerprint.", "coverage."))
         and not any(f.series == s for f in baseline_report.findings)
     }
     kept: list[GateFinding] = []

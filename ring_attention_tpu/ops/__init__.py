@@ -17,7 +17,9 @@ from .flash import (
     init_carry,
 )
 from .pallas_flash import (
+    BandPlan,
     QuantizedKV,
+    band_plan,
     pallas_flash_attention,
     pallas_flash_decode,
     pallas_flash_decode_q8,
@@ -131,6 +133,8 @@ __all__ = [
     "segments_overlap",
     "PAD_SEGMENT_ID",
     "SegmentIds",
+    "BandPlan",
+    "band_plan",
     "QuantizedKV",
     "pallas_flash_attention",
     "pallas_flash_decode",

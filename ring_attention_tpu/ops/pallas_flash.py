@@ -450,6 +450,119 @@ def _band_tile_count(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
     return count
 
 
+class BandPlan(NamedTuple):
+    """The compact causal grid a flash launch would run, as data.
+
+    The public seam over ``_band_tables`` / ``_band_tile_count`` for the
+    tile-coverage prover (``analysis/coverage.py``) and the on-chip tile
+    accounting (``tools/tpu_kernel_validate.py``): everything the kernels
+    derive from a band description, without launching anything.
+
+    ``tile_q`` / ``tile_k`` / ``flags`` are the scalar-prefetched tables
+    (one entry per grid step; ``flags`` is the FIRST|LAST|WORK|EDGE word);
+    ``tiles`` is the CLOSED-FORM count from :func:`_band_tile_count` —
+    kept separate from ``len(tile_q)`` on purpose, so callers can hold
+    the two implementations against each other (``tests/test_fuzz.py``).
+    """
+
+    tile_q: np.ndarray
+    tile_k: np.ndarray
+    flags: np.ndarray
+    tiles: int  # closed-form _band_tile_count (== len(tile_q) by contract)
+    block_q: int
+    block_k: int
+    n_q_blocks: int
+    n_k_blocks: int
+    hint: tuple[int, int, int, int]
+    windowed: bool
+    outer_is_q: bool
+    doc_starts: tuple[int, ...] | None  # layout the TABLES carry (aligned)
+    doc_aligned: bool  # False = declared layout fell back to runtime ids
+    compact: bool  # tiles within the SMEM cap (the grid the launch uses)
+
+    @property
+    def work_tiles(self) -> int:
+        return int((self.flags & _TF_WORK != 0).sum())
+
+    @property
+    def edge_tiles(self) -> int:
+        return int((self.flags & (_TF_WORK | _TF_EDGE)
+                    == (_TF_WORK | _TF_EDGE)).sum())
+
+
+def band_plan(
+    shape: tuple[int, int],
+    block_sizes: tuple[int | None, int | None] | None = None,
+    hint=0,
+    windowed: bool | None = None,
+    doc_starts: tuple[int, ...] | None = None,
+    *,
+    outer_is_q: bool = True,
+) -> BandPlan:
+    """Build the compact-grid tile plan for one banded flash sweep.
+
+    Args:
+      shape: ``(nq, nk)`` token extents of the sweep.
+      block_sizes: ``(block_q, block_k)``; ``None`` entries take the
+        kernel defaults through the same :func:`_block_sizes` fitting the
+        launches use.
+      hint: the static band — an int ``hi`` (plain causal offset), a
+        ``(hi, lo)`` pair (``lo=None`` = no window), or the full
+        ``(hi_work, hi_int, lo_work, lo_int)`` 4-tuple a ring hop's
+        :func:`~ring_attention_tpu.parallel.ring._static_hop_band`
+        produces (see :func:`_normalize_hint`).
+      windowed: whether the band has a lower bound.  Inferred for
+        int/pair hints; REQUIRED for a 4-tuple (its ``lo`` slots are
+        meaningful only when windowed).
+      doc_starts: declared packing layout (:func:`_check_doc_starts`).
+        When it lands on the chosen block boundaries the tables drop
+        cross-document tiles (``doc_aligned=True``); otherwise the plan
+        mirrors the launch-time fallback — band-only tables,
+        ``doc_aligned=False``, the document mask left to runtime ids.
+      outer_is_q: q-major iteration (fwd/dq passes) vs k-major (dk/dv).
+    """
+    nq, nk = int(shape[0]), int(shape[1])
+    bq, bk = _block_sizes(nq, nk, *(block_sizes or (None, None)))
+    if isinstance(hint, (int, np.integer)):
+        hint = (int(hint), int(hint), 0, 0)
+        if windowed is None:
+            windowed = False
+        elif windowed:
+            raise ValueError("band_plan: a windowed band needs a (hi, lo) "
+                             "pair or a 4-tuple hint, not a bare hi")
+    elif len(hint) == 2:
+        hi, lo = hint
+        windowed = lo is not None if windowed is None else windowed
+        if windowed and lo is None:
+            raise ValueError("band_plan: windowed=True needs a lower offset")
+        hint = (int(hi), int(hi), int(lo or 0), int(lo or 0))
+    elif len(hint) == 4:
+        if windowed is None:
+            raise ValueError(
+                "band_plan: a 4-tuple hint needs an explicit windowed= — "
+                "its lo slots are meaningful only under a window"
+            )
+        hint = tuple(int(x) for x in hint)
+    else:
+        raise ValueError(f"band_plan: hint {hint!r} must be an int, a "
+                         f"(hi, lo) pair, or a 4-tuple")
+    doc_starts = _check_doc_starts(doc_starts, nq, nk)
+    doc_aligned = (doc_starts is not None
+                   and _docs_block_aligned(doc_starts, bq, bk))
+    doc_tables = doc_starts if doc_aligned else None
+    nqb, nkb = nq // bq, nk // bk
+    tiles = _band_tile_count(nqb, nkb, bq, bk, hint, windowed, outer_is_q,
+                             doc_starts=doc_tables)
+    tq, tk, tf = _band_tables(nqb, nkb, bq, bk, hint, windowed, outer_is_q,
+                              doc_starts=doc_tables)
+    return BandPlan(
+        tile_q=tq, tile_k=tk, flags=tf, tiles=tiles, block_q=bq, block_k=bk,
+        n_q_blocks=nqb, n_k_blocks=nkb, hint=hint, windowed=bool(windowed),
+        outer_is_q=outer_is_q, doc_starts=doc_tables, doc_aligned=doc_aligned,
+        compact=tiles <= _MAX_COMPACT_TILES,
+    )
+
+
 def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
                  outer_is_q: bool, doc_starts=None):
     """(t_q, t_k, flags) int32 tables enumerating active band tiles.
@@ -508,8 +621,8 @@ def _band_tables(n_q_blocks, n_k_blocks, bq, bk, hint, windowed,
             tf.append(0)
         tf[start] |= _TF_FIRST
         tf[-1] |= _TF_LAST
-    return (np.asarray(tq, np.int32), np.asarray(tk, np.int32),
-            np.asarray(tf, np.int32))
+    return (np.asarray(tq, np.int32), np.asarray(tk, np.int32),  # ra: allow(RA009 trace-time static tile tables — python ints, never traced)
+            np.asarray(tf, np.int32))  # ra: allow(RA009 trace-time static tile tables — python ints, never traced)
 
 
 # ---------------------------------------------------------------------------

@@ -98,14 +98,14 @@ def compact_masked(gathered: jax.Array, mask: jax.Array, *, axis: int = 0) -> ja
     """
     import numpy as np
 
-    g = np.asarray(gathered)
-    m = np.asarray(mask).astype(bool)
+    g = np.asarray(gathered)  # ra: allow(RA009 compact_masked is documented host-only: output length is data-dependent)
+    m = np.asarray(mask).astype(bool)  # ra: allow(RA009 compact_masked is documented host-only: output length is data-dependent)
     if m.shape != (g.shape[axis],):
         raise ValueError(
             f"mask shape {m.shape} must be ({g.shape[axis]},) — the flat "
             f"validity mask returned by all_gather_variable for axis {axis}"
         )
-    return jnp.asarray(np.take(g, np.nonzero(m)[0], axis=axis))
+    return jnp.asarray(np.take(g, np.nonzero(m)[0], axis=axis))  # ra: allow(RA009 compact_masked is documented host-only: output length is data-dependent)
 
 
 def split_by_rank(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:

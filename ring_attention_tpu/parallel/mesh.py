@@ -64,7 +64,7 @@ def torus_ring_order(devices: list) -> list | None:
     by_coord: dict[tuple[int, ...], list] = {}
     for dev, c in zip(devices, coords):
         by_coord.setdefault(c, []).append(dev)
-    if len(by_coord) != int(np.prod(dims)):
+    if len(by_coord) != int(np.prod(dims)):  # ra: allow(RA009 host-side device-topology math on python ints)
         return None  # sparse / irregular slice: no dense snake exists
     per_chip = {len(v) for v in by_coord.values()}
     if len(per_chip) != 1:
@@ -151,7 +151,7 @@ def create_mesh(
             # row-major reshape puts consecutive snake neighbors along the
             # innermost (fastest-varying) axis: ulysses groups sit on the
             # closest links, ring ranks on adjacent ones
-            return Mesh(np.asarray(ordered).reshape(shape), axes)
+            return Mesh(np.asarray(ordered).reshape(shape), axes)  # ra: allow(RA009 host-side device-object array for Mesh construction)
         if not explicit:
             try:
                 from jax.experimental import mesh_utils
@@ -166,7 +166,7 @@ def create_mesh(
                     "back to flat device order — ring hops may cross "
                     "non-adjacent links"
                 )
-    arr = np.asarray(devices).reshape(shape)
+    arr = np.asarray(devices).reshape(shape)  # ra: allow(RA009 host-side device-object array for Mesh construction)
     return Mesh(arr, axes)
 
 
@@ -247,7 +247,7 @@ def shard_batch(batch, mesh: Mesh):
         # host-side ndarray: device_put / make_array_from_process_local_data
         # then transfer each shard directly, never staging the full array
         # through one device's HBM
-        x = np.asarray(x)
+        x = np.asarray(x)  # ra: allow(RA009 documented host-side placement helper, runs outside jit)
         if x.ndim >= 2:
             sharding = seq_sharding(mesh)
         elif x.ndim == 1:

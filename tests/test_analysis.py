@@ -400,6 +400,170 @@ def test_lint_named_scope_satisfies_ra004():
     assert lint_source(src, "ring_attention_tpu/parallel/toy.py") == []
 
 
+def test_corrupted_band_table_fails_soundness():
+    """A band table missing a live tile (the exact silent-wrong-attention
+    regression the prover exists for) fails with a one-line diagnostic
+    naming the tile and the soundness rule."""
+    import numpy as np
+
+    from ring_attention_tpu.analysis import coverage
+    from ring_attention_tpu.ops.pallas_flash import _TF_WORK, band_plan
+
+    n, blk = 32, 8
+    plan = band_plan((n, n), (blk, blk), 0)
+    truth = coverage.oracle_mask(np.arange(n), np.arange(n), None)
+    inst = [coverage.HopInstance(
+        rank=0, q_origin=0, kv_origin=0, oracle=truth, static_live=truth,
+        hi=0, lo=None, has_work=True, full=False, kpos=np.arange(n),
+    )]
+    assert coverage.verify_plan(plan, inst, "toy") == []
+    flags = plan.flags.copy()
+    live = [t for t in range(len(flags)) if flags[t] & _TF_WORK][2]
+    flags[live] &= ~_TF_WORK  # drop a live tile from the grid
+    violations = coverage.verify_plan(plan._replace(flags=flags), inst,
+                                      "toy")
+    line = violations[0]
+    assert "\n" not in line
+    assert "live tile" in line and "[rule: tile-coverage-sound]" in line
+    assert "q-tile" in line  # names the offending tile
+
+
+def test_widened_band_table_fails_tightness():
+    """A table built from a too-wide WORK bound visits dead tiles —
+    silent perf loss — and fails the tightness rule naming each tile."""
+    import numpy as np
+
+    from ring_attention_tpu.analysis import coverage
+    from ring_attention_tpu.ops.pallas_flash import band_plan
+
+    n, blk = 32, 8
+    truth = coverage.oracle_mask(np.arange(n), np.arange(n), None)
+    inst = [coverage.HopInstance(
+        rank=0, q_origin=0, kv_origin=0, oracle=truth, static_live=truth,
+        hi=0, lo=None, has_work=True, full=False, kpos=np.arange(n),
+    )]
+    wide = band_plan((n, n), (blk, blk), (blk, 0, 0, 0), windowed=False)
+    violations = coverage.verify_plan(wide, inst, "toy")
+    assert violations and all("\n" not in v for v in violations)
+    assert all("[rule: tile-coverage-tight]" in v for v in violations)
+    assert "dead tile" in violations[0]
+
+
+def test_bf16_accumulator_toy_fails_precision_flow():
+    """A bf16 accumulator carried through a scan (the drift bug the f32
+    contract forbids) fails the precision-flow pass in one line."""
+    from ring_attention_tpu.analysis import dataflow
+
+    def bad(x):
+        def body(acc, xi):
+            return acc + xi, None
+        acc, _ = lax.scan(body, jnp.zeros((8,), jnp.bfloat16), x)
+        return acc.astype(jnp.float32).sum()
+
+    violations = dataflow.audit_precision_flow(
+        bad, jnp.ones((4, 8), jnp.bfloat16), label="bf16_toy",
+    )
+    [line] = [v for v in violations if "loop carry" in v]
+    assert "\n" not in line
+    assert "bf16_toy" in line and "[rule: f32-accumulator-flow]" in line
+
+
+def test_int8_without_dequant_toy_fails_precision_flow():
+    """Quantized int8 content reaching a dot without its scale multiply
+    (the hop-compression hazard) is flagged; the real dequant pattern —
+    scale multiply first — is clean."""
+    from ring_attention_tpu.analysis import dataflow
+
+    y = jnp.ones((8, 8), jnp.float32)
+
+    def no_dequant(xq, y):
+        return (xq.astype(jnp.float32) @ y).sum()
+
+    violations = dataflow.audit_precision_flow(
+        no_dequant, jnp.ones((8, 8), jnp.int8), y, label="q_toy",
+    )
+    assert any("[rule: int8-dequant]" in v and "\n" not in v
+               for v in violations)
+
+    def dequant(xq, scale, y):
+        return ((xq.astype(jnp.float32) * scale) @ y).sum()
+
+    assert dataflow.audit_precision_flow(
+        dequant, jnp.ones((8, 8), jnp.int8), jnp.float32(0.1), y,
+        label="q_toy",
+    ) == []
+
+
+def test_branch_divergent_collective_toy_fails(devices):
+    """A cond whose branches issue DIFFERENT collective sequences (one
+    rank ppermutes, the other doesn't — the deadlock) fails the
+    divergence checker naming the branch; branches issuing the SAME
+    sequence pass — the proof-level upgrade over the PR-5 blanket ban."""
+    from ring_attention_tpu.analysis import dataflow
+
+    mesh = create_mesh(ring_size=8)
+    spec = P("data", None, "seq", None)
+    perm = [(j, (j + 1) % 8) for j in range(8)]
+
+    def divergent(q):
+        rank = lax.axis_index(SEQ_AXIS)
+        return lax.cond(
+            rank % 2 == 0,
+            lambda x: lax.ppermute(x, SEQ_AXIS, perm),
+            lambda x: x,
+            q,
+        )
+
+    fn = compat.shard_map(divergent, mesh=mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False)
+    x = jnp.ones((1, 8, 64, 8), jnp.float32)
+    [line] = dataflow.check_spmd_divergence(jax.make_jaxpr(fn)(x), "toy")
+    assert "\n" not in line
+    assert "branch 1" in line
+    assert "[rule: branch-collective-divergence]" in line
+
+    def convergent(q):
+        rank = lax.axis_index(SEQ_AXIS)
+        return lax.cond(
+            rank % 2 == 0,
+            lambda x: lax.ppermute(x * 2, SEQ_AXIS, perm),
+            lambda x: lax.ppermute(x + 1, SEQ_AXIS, perm),
+            q,
+        )
+
+    fn2 = compat.shard_map(convergent, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec, check_vma=False)
+    assert dataflow.check_spmd_divergence(jax.make_jaxpr(fn2)(x)) == []
+
+
+def test_lint_ra009_host_numpy_in_traced_code():
+    """RA009: a host numpy call in a traced subpackage flags; the
+    reasoned allow and non-traced modules are clean (np.random stays
+    RA005's)."""
+    import textwrap as tw
+
+    bad = tw.dedent("""
+        import numpy as np
+
+        def f(x):
+            return np.exp(x)
+    """)
+    violations = lint_source(bad, "ring_attention_tpu/ops/toy.py")
+    assert [v.rule for v in violations] == ["RA009"]
+    assert "jnp" in violations[0].message
+
+    allowed = bad.replace(
+        "np.exp(x)",
+        "np.exp(x)  # ra: allow(RA009 static trace-time constant)",
+    )
+    assert lint_source(allowed, "ring_attention_tpu/ops/toy.py") == []
+    # utils/ is host-side: not in RA009 scope
+    assert lint_source(bad, "ring_attention_tpu/utils/toy.py") == []
+    rng = "import numpy as np\ndef f():\n    return np.random.rand(3)\n"
+    assert [v.rule for v in
+            lint_source(rng, "ring_attention_tpu/ops/toy.py")] == ["RA005"]
+
+
 # ----------------------------------------------------------------------
 # Self-runs: the package itself is clean
 # ----------------------------------------------------------------------

@@ -113,6 +113,55 @@ def test_fuzz_random_packings(mesh, case):
     )
 
 
+def test_band_tile_count_matches_enumeration_fuzz():
+    """Hypothesis-style property, fixed seed: over fuzzed (nq, nk, bq,
+    bk, band, window, doc_starts, iteration-order) shapes, the
+    closed-form ``_band_tile_count`` equals the enumerated
+    ``_band_tables`` length — the property every launch's SMEM-cap
+    decision (and the coverage prover's tile accounting) rides on.
+    Exercised through the public ``band_plan`` seam, which keeps the two
+    implementations deliberately un-merged so this test means something.
+    """
+    from ring_attention_tpu.ops.pallas_flash import band_plan
+
+    rng = np.random.default_rng(0xBA2D)
+    for trial in range(150):
+        bq = int(2 ** rng.integers(0, 4))  # 1..8
+        bk = int(2 ** rng.integers(0, 4))
+        n_blocks = int(rng.integers(1, 9))
+        # doc_starts requires nq == nk; the band arithmetic itself is
+        # exercised at unequal extents when no docs are drawn
+        nq = bq * n_blocks
+        nk = bk * n_blocks if rng.random() < 0.5 else bk * int(
+            rng.integers(1, 9)
+        )
+        hi_w = int(rng.integers(-nq - 2, nk + 2))
+        hi_i = hi_w - int(rng.integers(0, 3))
+        windowed = bool(rng.random() < 0.5)
+        lo_w = int(rng.integers(-nq - 2, hi_w + 1)) if windowed else 0
+        lo_i = lo_w + int(rng.integers(0, 3)) if windowed else 0
+        doc_starts = None
+        if nq == nk and nq > 1 and rng.random() < 0.4:
+            n_docs = int(rng.integers(1, 4))
+            cuts = sorted({0, *(
+                int(x) for x in rng.integers(1, nq, n_docs - 1)
+            )})
+            doc_starts = tuple(cuts)
+        outer_is_q = bool(rng.random() < 0.5)
+        plan = band_plan(
+            (nq, nk), (bq, bk), (hi_w, hi_i, lo_w, lo_i),
+            windowed=windowed, doc_starts=doc_starts,
+            outer_is_q=outer_is_q,
+        )
+        assert plan.tiles == len(plan.tile_q), (
+            f"trial {trial}: closed form {plan.tiles} != enumerated "
+            f"{len(plan.tile_q)} at nq={nq} nk={nk} bq={bq} bk={bk} "
+            f"hint={(hi_w, hi_i, lo_w, lo_i)} windowed={windowed} "
+            f"docs={doc_starts} outer_is_q={outer_is_q}"
+        )
+        assert len(plan.tile_q) == len(plan.tile_k) == len(plan.flags)
+
+
 def test_bidirectional_bucket_divides_full_but_not_half():
     """Bucket divides the full shard but not the half-streams (n_local=12,
     bucket=4): the per-stream refit in parallel/ring.py must fit the bucket

@@ -190,8 +190,22 @@ def test_check_contracts_flags_parse():
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
-    for flag in ("--strategy", "--mesh", "--json", "--devices", "--memory"):
+    for flag in ("--strategy", "--mesh", "--json", "--devices", "--memory",
+                 "--coverage", "--dataflow"):
         assert flag in proc.stdout, f"{flag} missing from --help"
+
+
+def test_check_contracts_coverage_exits_zero():
+    """Acceptance: ``check_contracts.py --coverage`` proves soundness AND
+    tightness for every strategy x layout x masking row on CPU and exits
+    0.  Numpy-only after import — no mesh, no compiles, cheap enough for
+    a subprocess smoke."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--coverage"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "coverage rows sound and tight" in proc.stdout
 
 
 def test_check_contracts_knows_counter_variants():

@@ -14,11 +14,25 @@ audit is live), donation aliasing and host-offload placement of the
 composed train step, and the chunked-vs-dense compiled peak-temp-bytes
 relation — the machine-checked version of docs/memory.md's claims.
 
+``--coverage`` runs the tile-coverage prover (``analysis/coverage.py``):
+every strategy x layout x masking row's compact skip grid held to a
+global-position oracle — soundness (no live tile skipped), tightness
+(no dead tile visited, closed-form count == enumeration), and schedule
+completeness (each element exactly once across the hops).
+
+``--dataflow`` runs the jaxpr dataflow passes (``analysis/dataflow.py``):
+the precision-flow auditor (bf16/int8 taint to every reduction and
+accumulator carry — both flash paths, the int8 hop chain, the counter
+bwd pack) and the SPMD divergence checker (branch-invariant collective
+sequences for every strategy, on simulated devices).
+
 Examples:
   python tools/check_contracts.py --strategy all
   python tools/check_contracts.py --strategy hybrid --mesh 1x2x4
   python tools/check_contracts.py --strategy ring --mesh 2x4 --json
   python tools/check_contracts.py --memory
+  python tools/check_contracts.py --coverage
+  python tools/check_contracts.py --dataflow
 
 Exit status 0 = every contract holds.  Runs anywhere (no TPU needed):
 ``--devices N`` simulated host devices, default 8.
@@ -76,6 +90,15 @@ def main(argv: list[str] | None = None) -> int:
                              "aliasing, host-offload placement, chunked-"
                              "vs-dense peak temp bytes) instead of the "
                              "collective contracts")
+    parser.add_argument("--coverage", action="store_true",
+                        help="run the tile-coverage prover (skip-grid "
+                             "soundness/tightness/schedule completeness "
+                             "per strategy x layout x masking row) "
+                             "instead of the collective contracts")
+    parser.add_argument("--dataflow", action="store_true",
+                        help="run the jaxpr dataflow passes (precision-"
+                             "flow audit + SPMD divergence checker) "
+                             "instead of the collective contracts")
     args = parser.parse_args(argv)
 
     # must precede the first jax import
@@ -84,6 +107,55 @@ def main(argv: list[str] | None = None) -> int:
         + f" --xla_force_host_platform_device_count={args.devices}"
     )
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.coverage:
+        from ring_attention_tpu.analysis.coverage import run_coverage_suite
+
+        reports = run_coverage_suite()
+        failed = [r for r in reports if not r.ok]
+        if args.json:
+            print(json.dumps({
+                "ok": not failed,
+                "checked": len(reports),
+                "reports": [r.to_json() for r in reports],
+            }, indent=2))
+        else:
+            for r in reports:
+                mark = "ok  " if r.ok else "FAIL"
+                print(f"{mark} {r.name:<32} hops={r.hops:<2} "
+                      f"tiles={r.tiles:<4} work={r.work:<4} "
+                      f"edge={r.edge:<4} kmajor={r.tiles_kmajor}")
+                for v in r.violations:
+                    print(f"     {v}")
+            print(f"{len(reports) - len(failed)}/{len(reports)} coverage "
+                  f"rows sound and tight")
+        return 1 if failed else 0
+
+    if args.dataflow:
+        from ring_attention_tpu.analysis.dataflow import (
+            run_divergence_suite,
+            run_precision_suite,
+        )
+
+        checks = run_precision_suite() + run_divergence_suite()
+        failed_names = [name for name, v in checks if v]
+        if args.json:
+            print(json.dumps({
+                "ok": not failed_names,
+                "checked": len(checks),
+                "checks": [
+                    {"name": name, "ok": not v, "violations": v}
+                    for name, v in checks
+                ],
+            }, indent=2))
+        else:
+            for name, v in checks:
+                print(f"{'ok  ' if not v else 'FAIL'} {name}")
+                for line in v:
+                    print(f"     {line}")
+            print(f"{len(checks) - len(failed_names)}/{len(checks)} "
+                  f"dataflow checks hold")
+        return 1 if failed_names else 0
 
     if args.memory:
         from ring_attention_tpu.analysis.recompile import run_memory_suite

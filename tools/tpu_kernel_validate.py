@@ -109,11 +109,7 @@ def main() -> None:
     if args.segments:
         import numpy as np
 
-        from ring_attention_tpu.ops.pallas_flash import (
-            _MAX_COMPACT_TILES,
-            _TF_WORK,
-            _band_tables,
-        )
+        from ring_attention_tpu.ops.pallas_flash import band_plan
 
         n_docs = args.segments
         if n0 % n_docs:
@@ -166,19 +162,19 @@ def main() -> None:
         # causal grid the declared packing drops at trace time
         bq = bk = 1024
         if args.seq % n_docs == 0 and (args.seq // n_docs) % bq == 0:
-            nblk = args.seq // bq
             starts_t = tuple(range(0, args.seq, args.seq // n_docs))
-            plain = _band_tables(nblk, nblk, bq, bk, (0, 0, 0, 0), False,
-                                 outer_is_q=True)
-            docs_t = _band_tables(nblk, nblk, bq, bk, (0, 0, 0, 0), False,
-                                  outer_is_q=True, doc_starts=starts_t)
-            w_plain = int((plain[2] & _TF_WORK != 0).sum())
-            w_docs = int((docs_t[2] & _TF_WORK != 0).sum())
+            plain = band_plan((args.seq, args.seq), (bq, bk), 0)
+            docs_p = band_plan((args.seq, args.seq), (bq, bk), 0,
+                               doc_starts=starts_t)
             print(json.dumps({
                 "segments": n_docs, "seq": args.seq, "block": bq,
-                "work_tiles_plain": w_plain, "work_tiles_docs": w_docs,
-                "tiles_dropped_frac": round(1 - w_docs / w_plain, 4),
-                "compact": docs_t[0].shape[0] <= _MAX_COMPACT_TILES,
+                "work_tiles_plain": plain.work_tiles,
+                "work_tiles_docs": docs_p.work_tiles,
+                "tiles_dropped_frac": round(
+                    1 - docs_p.work_tiles / plain.work_tiles, 4
+                ),
+                "compact": docs_p.compact,
+                "doc_aligned": docs_p.doc_aligned,
             }))
         else:
             print(json.dumps({
