@@ -221,6 +221,51 @@ def _coverage_worker() -> None:
     print(json.dumps(coverage_fingerprint()))
 
 
+def _window262k_worker(extra: dict) -> None:
+    """Sliding-window 262k certified-grid accounting (CPU-countable).
+
+    Lowers ``Causal() & SlidingWindow(w)`` and plain ``Causal()`` at the
+    north-star forward shape through the mask algebra (the same
+    ``band_plan`` grids a Pallas launch would run), certifies both
+    (``masks.certify`` — elementwise proof at the capped spec, closed-
+    form-vs-enumeration tile accounting at the full 262k shape), and
+    reports the certified work-tile reduction the window buys over
+    causal.  Pure numpy — rides the pre-probe slot like the coverage
+    fingerprint, so the number lands even on wedged-TPU rounds; a timed
+    windowed forward belongs to a future hardware phase.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from ring_attention_tpu import masks as M
+
+    seq = int(extra.get("seq", TARGET_SEQ))
+    window = int(extra.get("window", 4096))
+    block = int(extra.get("block", 1024))
+    spec = M.GridSpec(strategy="single", n_local=seq, block_q=block,
+                      block_k=block)
+    masks = {
+        "causal": M.Causal(),
+        "window": M.Causal() & M.SlidingWindow(window),
+    }
+    payload: dict = {"seq": seq, "window": window, "block": block}
+    tiles = {}
+    for name, mask in masks.items():
+        cert = M.certify(mask, spec)
+        low = M.lower(mask, spec)
+        work = sum(h.plan.work_tiles for h in low.hops if h.plan is not None)
+        total = sum(len(h.plan.tile_q) for h in low.hops
+                    if h.plan is not None)
+        tiles[name] = work
+        payload[f"{name}_work_tiles"] = work
+        payload[f"{name}_tiles"] = total
+        payload[f"{name}_certified"] = cert.ok
+        payload[f"{name}_proof_n"] = cert.proof_n
+    payload["tile_reduction_x"] = round(
+        tiles["causal"] / max(tiles["window"], 1), 2
+    )
+    print(json.dumps(payload))
+
+
 def _train1m_mem_worker(extra: dict) -> None:
     """CPU-provable half of the ``train1m`` phase: the memory claim.
 
@@ -1249,6 +1294,20 @@ def main() -> None:
     else:
         result["coverage_fingerprint"] = {"error": (cov_err or "failed")[-200:]}
 
+    # phase 0d — sliding-window 262k certified-grid accounting (numpy-
+    # only, pre-probe): the work-tile reduction the certified window
+    # grid buys over causal at the north-star shape — the scenario-
+    # diversity half of the mask algebra as a number in BENCH output,
+    # wedged rounds included
+    win, win_err = _run_attempt(
+        "cpu", 0, "window262k",
+        float(os.environ.get("BENCH_WIN_BUDGET_S", 180)),
+    )
+    if win is not None:
+        result["window262k"] = win
+    else:
+        result["window262k"] = {"error": (win_err or "failed")[-200:]}
+
     # phase 0c — train1m memory proof (CPU-only, pre-probe like the
     # fingerprint): chunked-vs-dense compiled peak temp bytes at equal
     # shape + the analytic 2^20-token peak-HBM estimate, so the
@@ -1566,6 +1625,8 @@ if __name__ == "__main__":
             _fingerprint_worker()
         elif mode == "coverage":
             _coverage_worker()
+        elif mode == "window262k":
+            _window262k_worker(extra)
         elif mode == "train1m_mem":
             # likewise CPU-forced before the first jax import
             _train1m_mem_worker(extra)

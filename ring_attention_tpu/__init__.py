@@ -11,6 +11,20 @@ embeddings, and RingAttention / RingTransformer model layers.
 
 __version__ = "0.1.0"
 
+from . import masks
+from .masks import (
+    And,
+    Causal,
+    Dilated,
+    DocumentMask,
+    Full,
+    Not,
+    Or,
+    PerHead,
+    PrefixLM,
+    Segments,
+    SlidingWindow,
+)
 from .models import FeedForward, RingAttention, RingTransformer, RMSNorm
 from .utils import StepTimer, restore_checkpoint, save_checkpoint, trace
 from .ops import (
@@ -46,7 +60,19 @@ from .parallel import (
 )
 
 __all__ = [
+    "And",
+    "Causal",
+    "Dilated",
+    "DocumentMask",
     "FeedForward",
+    "Full",
+    "Not",
+    "Or",
+    "PerHead",
+    "PrefixLM",
+    "Segments",
+    "SlidingWindow",
+    "masks",
     "PAD_SEGMENT_ID",
     "SegmentIds",
     "StepTimer",

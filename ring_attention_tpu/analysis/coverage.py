@@ -119,6 +119,22 @@ class HopInstance:
     kpos: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
     # ^ global key columns this instance computes against (striped layouts
     #   deliver non-contiguous columns — the schedule check indexes them)
+    rt_mask: np.ndarray | None = None
+    # ^ generic runtime edge mask (mask-algebra lowerings that are not a
+    #   band): when present it replaces the band/segment construction as
+    #   the runtime predicate under test
+
+
+def _instance_runtime(x: HopInstance, nq: int, nk: int) -> np.ndarray:
+    """The runtime edge-tile mask this instance's kernel would apply —
+    the band scalars (optionally intersected with the runtime document
+    mask), or the generic lowering's predicate."""
+    if x.rt_mask is not None:
+        return x.rt_mask
+    rt = band_mask(nq, nk, x.hi, x.lo)
+    if x.seg_mask is not None:
+        rt = rt & x.seg_mask
+    return rt
 
 
 def _tile_slices(plan, qi: int, ki: int):
@@ -231,9 +247,7 @@ def verify_plan(plan, instances: list[HopInstance], label: str) -> list[str]:
                     f"[rule: tile-coverage-sound]"
                 )
             continue
-        rt_band = band_mask(nq, nk, x.hi, x.lo)
-        extra = (x.seg_mask if x.seg_mask is not None
-                 else np.ones((nq, nk), bool))
+        rt = _instance_runtime(x, nq, nk)
         for qi in range(plan.n_q_blocks):
             for ki in range(plan.n_k_blocks):
                 qs, ks = _tile_slices(plan, qi, ki)
@@ -257,7 +271,7 @@ def verify_plan(plan, instances: list[HopInstance], label: str) -> list[str]:
                             f"[rule: tile-coverage-sound]"
                         )
                     continue
-                computed = rt_band[qs, ks] & extra[qs, ks]
+                computed = rt[qs, ks]
                 if not np.array_equal(computed, o_tile):
                     kept_dead = computed & ~o_tile
                     kind = ("keeps a dead element" if kept_dead.any()
@@ -283,7 +297,7 @@ def verify_plan(plan, instances: list[HopInstance], label: str) -> list[str]:
                     f"[rule: tile-coverage-tight]"
                 )
             elif edge and all(
-                band_mask(nq, nk, x.hi, x.lo)[qs, ks].all() for x in active
+                _instance_runtime(x, nq, nk)[qs, ks].all() for x in active
             ):
                 out.append(
                     f"{label}: tile (q-tile {qi}, k-tile {ki}) is "
@@ -632,15 +646,231 @@ def prove_zigzag(ring: int = 4, chunk: int = 8, block: int = 8,
 
 
 # ---------------------------------------------------------------------------
+# Mask-algebra rows: arbitrary oracles through the certifying compiler
+# ---------------------------------------------------------------------------
+#
+# PR 11 generalizes the fixed matrix above: ``ring_attention_tpu/masks.py``
+# lowers arbitrary mask compositions to the same compact tile tables and
+# per-hop work/skip schedules, and :func:`prove_mask_lowering` holds every
+# emitted grid to the mask's own global-position oracle.  Band-shaped
+# masks lower through the SHIPPING seams (band_plan + the ring hop-band
+# helpers), so those rows re-certify the real kernels' grids through the
+# mask API; generic masks (prefix-LM, dilated, per-head, Or/Not
+# compositions) certify the algebra's tile classifier — the extension
+# seam future kernels will launch from.
+
+
+@dataclass(frozen=True)
+class MaskCoverageCase:
+    """One mask-algebra matrix row: a textual mask expression (through
+    the registry parser, so the row also exercises the mini-language)
+    over one execution geometry."""
+
+    name: str
+    expr: str
+    strategy: str = "single"
+    layout: str = "contiguous"
+    ring: int = 1
+    n_local: int = 64
+    block: int = 8
+    passes: int | None = None
+
+
+MASK_CASES: tuple[MaskCoverageCase, ...] = (
+    MaskCoverageCase("mask/single/full", "full"),
+    MaskCoverageCase("mask/single/causal", "causal"),
+    MaskCoverageCase("mask/single/causal-window", "causal&window:24"),
+    MaskCoverageCase("mask/single/window-2sided", "window:16"),
+    MaskCoverageCase("mask/single/prefixlm", "prefix:24"),
+    MaskCoverageCase("mask/single/prefix-window", "prefix:16&window:24"),
+    MaskCoverageCase("mask/single/dilated", "causal&dilated:4"),
+    MaskCoverageCase("mask/single/docs-causal", "causal&docs:0,16,40"),
+    MaskCoverageCase("mask/single/docs-misaligned", "causal&docs:0,12,40"),
+    MaskCoverageCase("mask/single/prefix-or-docs", "prefix:16|docs:0,32"),
+    MaskCoverageCase("mask/single/far-past", "causal&~window:8"),
+    MaskCoverageCase("mask/single/perhead",
+                     "perhead(causal;causal&window:16)"),
+    MaskCoverageCase("mask/ring/causal", "causal", strategy="ring",
+                     ring=4, n_local=16, block=4),
+    MaskCoverageCase("mask/ring/causal-window", "causal&window:24",
+                     strategy="ring", ring=4, n_local=16, block=4),
+    MaskCoverageCase("mask/ring/striped-window", "causal&window:20",
+                     strategy="ring", layout="striped", ring=4,
+                     n_local=16, block=4),
+    MaskCoverageCase("mask/ring/limited-passes", "causal&window:8",
+                     strategy="ring", ring=4, n_local=16, block=4,
+                     passes=2),
+    MaskCoverageCase("mask/ring/prefixlm", "prefix:24", strategy="ring",
+                     ring=4, n_local=16, block=4),
+    MaskCoverageCase("mask/ring/dilated", "causal&dilated:2",
+                     strategy="ring", ring=4, n_local=16, block=4),
+    MaskCoverageCase("mask/counter/causal", "causal", strategy="counter",
+                     ring=4, n_local=16, block=4),
+    MaskCoverageCase("mask/counter/window", "causal&window:24",
+                     strategy="counter", ring=4, n_local=16, block=4),
+    MaskCoverageCase("mask/counter/prefixlm", "prefix:24",
+                     strategy="counter", ring=4, n_local=16, block=4),
+)
+
+
+def _expected_pairings(spec, i: int) -> list[tuple[int, int, int]]:
+    """``(rank, q_origin, kv_origin)`` rows of hop ``i`` — recomputed
+    HERE from the schedule definitions (single sweep; ring: hop ``i``
+    delivers origin ``rank - i``; counter-rotation: the Q stream has
+    moved ``ceil(i/2)`` times and KV ``floor(i/2)``, pairing invariant
+    ``q_origin - kv_origin ≡ i``), independently of the lowering's own
+    origin bookkeeping, which is cross-checked against this."""
+    if spec.strategy == "single":
+        return [(0, 0, 0)]
+    W = spec.ring
+    if spec.strategy == "counter":
+        return [
+            (r, (r + (i + 1) // 2) % W, (r - i // 2) % W) for r in range(W)
+        ]
+    return [(r, r, (r - i) % W) for r in range(W)]
+
+
+def prove_mask_lowering(mask, spec, lowering=None) -> CoverageReport:
+    """Hold one mask lowering (``masks.lower(mask, spec)``) to the
+    mask's own oracle: per-hop table soundness/tightness on the q-major
+    AND k-major grids, hop-pairing agreement with the independently
+    recomputed schedule, and cross-hop exactly-once completeness.
+
+    ``lowering`` overrides the freshly-built one (the negative-toy seam:
+    a doctored lowering must fail with a one-line diagnostic naming the
+    mask, hop, and tile)."""
+    from .. import masks as masks_mod
+
+    mask = masks_mod.static_mask(mask)  # runtime Segments mask in-kernel
+    m = mask.head_mask(spec.head) if mask.per_head else mask
+    if lowering is None:
+        lowering = masks_mod.lower(mask, spec)
+    W, n = spec.ring, spec.n_local
+    report = CoverageReport(name=f"{m.key}/{spec.strategy}")
+    counts = {o: np.zeros((n, n * W), np.int64) for o in range(W)}
+    visited = {o: np.zeros(n * W, bool) for o in range(W)}
+
+    for hop in lowering.hops:
+        report.hops += 1
+        label = f"{m.key}/{spec.strategy}:{spec.layout}/hop{hop.hop}"
+        expected = _expected_pairings(spec, hop.hop)
+        if len(hop.ranks) != len(expected):
+            report.violations.append(
+                f"{label}: lowering schedules {len(hop.ranks)} ranks, "
+                f"the {spec.strategy} hop has {len(expected)} "
+                f"[rule: tile-coverage-sound]"
+            )
+            continue
+        instances = []
+        for rp, (r, qo, ko) in zip(hop.ranks, expected):
+            if (rp.rank, rp.q_origin, rp.kv_origin) != (r, qo, ko):
+                report.violations.append(
+                    f"{label}: rank {r} pairing disagrees — lowering says "
+                    f"q-origin {rp.q_origin} x kv-origin {rp.kv_origin}, "
+                    f"the schedule pairs {qo} x {ko} "
+                    f"[rule: tile-coverage-sound]"
+                )
+            qpos = _positions(spec.layout, qo, n, W)
+            kpos = _positions(spec.layout, ko, n, W)
+            truth = m.oracle(qpos, kpos)
+            instances.append(HopInstance(
+                rank=r, q_origin=qo, kv_origin=ko, oracle=truth,
+                static_live=truth, hi=rp.hi, lo=rp.lo,
+                has_work=rp.has_work, full=hop.full, kpos=kpos,
+                rt_mask=rp.rt_mask,
+            ))
+        if hop.full:
+            for x in instances:
+                if x.has_work and not x.oracle.all():
+                    i, j = np.argwhere(~x.oracle)[0]
+                    report.violations.append(
+                        f"{label}: rank {x.rank} declared-full span holds "
+                        f"a masked-out element at local ({int(i)}, "
+                        f"{int(j)}) — it would enter the softmax unmasked "
+                        f"[rule: tile-coverage-sound]"
+                    )
+                elif not x.has_work and x.oracle.any():
+                    report.violations.append(
+                        f"{label}: rank {x.rank} hop-level skip drops "
+                        f"live work [rule: tile-coverage-sound]"
+                    )
+        elif hop.plan is None or hop.plan_kmajor is None:
+            report.violations.append(
+                f"{label}: non-full hop lowered without tile tables "
+                f"[rule: tile-coverage-sound]"
+            )
+            continue
+        else:
+            report.tiles += len(hop.plan.tile_q)
+            report.work += hop.plan.work_tiles
+            report.edge += hop.plan.edge_tiles
+            report.violations.extend(verify_plan(hop.plan, instances,
+                                                 label))
+            report.tiles_kmajor += len(hop.plan_kmajor.tile_q)
+            report.violations.extend(
+                verify_plan(hop.plan_kmajor, instances, label + "/dkv")
+            )
+        for x in instances:
+            if x.has_work:
+                visited[x.q_origin][x.kpos] = True
+                counts[x.q_origin][:, x.kpos] += (
+                    1 if x.full else x.oracle
+                )
+
+    for o in range(W):
+        qpos = _positions(spec.layout, o, n, W)
+        intended = m.oracle(qpos, np.arange(n * W))
+        intended = intended & visited[o][None, :]
+        if not np.array_equal(counts[o], intended.astype(np.int64)):
+            diff = counts[o] - intended.astype(np.int64)
+            i, j = np.argwhere(diff)[0]
+            kind = ("dropped from" if diff[i, j] < 0
+                    else "double-counted into")
+            report.violations.append(
+                f"{m.key}/{spec.strategy}: schedule {kind} the softmax: "
+                f"q-origin {o} element (local q {int(i)}, global k "
+                f"{int(j)}) computed {int(counts[o][i, j])}x, intended "
+                f"{int(intended[i, j])}x [rule: tile-coverage-sound]"
+            )
+    return report
+
+
+def prove_mask_case(case: MaskCoverageCase) -> CoverageReport:
+    """One mask-algebra matrix row: parse the expression, lower it onto
+    the case's geometry, and prove every head variant's grids."""
+    from ..masks import GridSpec, parse_mask
+
+    mask = parse_mask(case.expr)
+    heads = mask.head_period
+    report = CoverageReport(name=case.name)
+    for h in range(heads):
+        spec = GridSpec(
+            strategy=case.strategy, layout=case.layout, ring=case.ring,
+            n_local=case.n_local, block_q=case.block,
+            block_k=case.block, passes=case.passes, head=h,
+        )
+        part = prove_mask_lowering(mask, spec)
+        report.violations.extend(part.violations)
+        report.hops += part.hops
+        report.tiles += part.tiles
+        report.work += part.work
+        report.edge += part.edge
+        report.tiles_kmajor += part.tiles_kmajor
+    return report
+
+
+# ---------------------------------------------------------------------------
 # Suite + fingerprint
 # ---------------------------------------------------------------------------
 
 
 def run_coverage_suite() -> list[CoverageReport]:
-    """Every matrix row.  All-ok == the compact grids are proven sound
-    and tight for every strategy x layout x masking combination shipped."""
+    """Every matrix row — the fixed strategy x layout x masking rows,
+    the zig-zag rectangular-grid row, and the mask-algebra rows.
+    All-ok == every grid the compiler emits is proven sound and tight."""
     reports = [prove_case(case) for case in CASES]
     reports.append(prove_zigzag())
+    reports.extend(prove_mask_case(case) for case in MASK_CASES)
     return reports
 
 

@@ -46,6 +46,19 @@ past PR, with the shim/convention that prevents it:
          genuinely static trace-time data (device topology, tile tables)
          is legitimate and carries a reasoned allow.  ``np.random.*``
          stays RA005's.
+  RA010  Pallas grid tables or hop skip-predicates constructed outside
+         the ``band_plan()`` / mask-algebra seam.  Calling the private
+         table/offset/skip constructors (``_band_tables`` /
+         ``_band_tile_count`` / ``_hop_offsets`` / ``_stream_offsets`` /
+         ``_static_hop_band`` / ``_counter_static_band`` /
+         ``_hop_has_work`` / ``_tile_has_work`` / ``_tile_is_edge``)
+         from outside their home modules (``ops/pallas_flash.py``,
+         ``parallel/ring.py``), ``masks.py`` (the algebra's lowering),
+         or ``analysis/`` (the certifier) builds a skip grid the
+         coverage prover never sees — the exact bypass that would dodge
+         certification.  New grids go through ``band_plan()`` or the
+         mask algebra, which certify; anything else carries a reasoned
+         allow.
 
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
@@ -82,6 +95,27 @@ COLLECTIVE_CALLS = {
 }
 
 HOST_TIME_ATTRS = {"time", "time_ns", "perf_counter", "monotonic", "process_time"}
+
+# RA010: the private grid-table / hop-skip constructors, and the modules
+# that ARE the seam (their homes, the mask algebra's lowering, and the
+# analysis passes that certify them).
+GRID_SEAM_CALLS = {
+    "_band_tables",
+    "_band_tile_count",
+    "_hop_offsets",
+    "_stream_offsets",
+    "_static_hop_band",
+    "_counter_static_band",
+    "_hop_has_work",
+    "_tile_has_work",
+    "_tile_is_edge",
+}
+GRID_SEAM_MODULES = (
+    "ops/pallas_flash.py",
+    "parallel/ring.py",
+    "ring_attention_tpu/masks.py",
+    "analysis/",
+)
 
 # RA008: metric-name unit suffixes (docs/observability.md glossary)
 METRIC_UNIT_SUFFIXES = ("_bytes", "_sec", "_count", "_frac")
@@ -131,6 +165,9 @@ class _Linter(ast.NodeVisitor):
         self.scope_depth = 0  # nesting inside `with jax.named_scope(...)`
         self.collecting_depth = 0  # nesting inside `with ....collecting()`
         self.is_shim = rel.replace("\\", "/").endswith(SHIM_MODULE)
+        self.in_grid_seam = any(
+            m in rel.replace("\\", "/") for m in GRID_SEAM_MODULES
+        )
         self.traced_pkg = any(
             rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
             or f"/{p}/" in rel.replace("\\", "/")
@@ -201,6 +238,13 @@ class _Linter(ast.NodeVisitor):
                 self.flag(node, "RA003",
                           "pl.pallas_call without name= — kernel is "
                           "unattributable in XProf traces")
+
+        if name in GRID_SEAM_CALLS and not self.in_grid_seam:
+            self.flag(node, "RA010",
+                      f"grid/skip constructor {name}() outside the "
+                      "band_plan()/mask-algebra seam — this skip grid "
+                      "dodges the coverage certifier; lower through "
+                      "band_plan() or ring_attention_tpu.masks")
 
         if name in COLLECTIVE_CALLS and self.scope_depth == 0:
             self.flag(node, "RA004",
@@ -341,7 +385,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA009)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA010)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

@@ -28,10 +28,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import masks as mask_algebra
 from ..ops.attention import PAD_SEGMENT_ID, default_attention
 from ..ops.flash import flash_attention
 from ..ops.pallas_flash import (
     QuantizedKV,
+    _doc_runtime_ids,
     dequantize_kv_cache as _dequantize,
     pallas_flash_attention,
     pallas_flash_decode,
@@ -78,6 +80,17 @@ class RingAttention(nn.Module):
     dim_head: int = 64
     kv_heads: int | None = None
     causal: bool = False
+    # mask-algebra expression (ring_attention_tpu.masks): the general
+    # form of the masking knobs — ``causal=True`` is sugar for
+    # ``mask=Causal()``, ``mask=Causal() & SlidingWindow(w)`` replaces
+    # ``max_lookback_seq_len=w``, ``... & DocumentMask(starts)`` declares
+    # a packed layout, ``... & Segments()`` requires runtime segment_ids.
+    # The lowering is certified sound/tight/complete at trace time
+    # against the mask's own oracle (masks.require_certified, cached);
+    # expressions beyond the kernel surface raise MaskLoweringError
+    # naming the supported forms.  Mutually exclusive with causal=True
+    # and max_lookback_seq_len (compose them into the mask instead)
+    mask: mask_algebra.Mask | None = None
     striped: bool = False
     bucket_size: int = 512
     use_ring: bool = True
@@ -153,6 +166,61 @@ class RingAttention(nn.Module):
         kvh = self.kv_heads or self.heads
         assert self.heads % kvh == 0
         return kvh
+
+    def _mask_form(self) -> mask_algebra.KernelForm | None:
+        """The algebra mask resolved onto the kernel knobs (or None).
+        Raises on conflicting legacy knobs and on masks beyond the
+        kernel surface (MaskLoweringError names the supported forms)."""
+        if self.mask is None:
+            return None
+        if self.causal:
+            raise ValueError(
+                "RingAttention: mask= replaces causal=True (causal=True "
+                "is sugar for mask=Causal()); set only one"
+            )
+        if self.max_lookback_seq_len is not None:
+            raise ValueError(
+                "RingAttention: mask= replaces max_lookback_seq_len — "
+                "compose SlidingWindow(w) into the mask instead"
+            )
+        return mask_algebra.kernel_form(self.mask)
+
+    def _eff_causal(self) -> bool:
+        form = self._mask_form()
+        return self.causal if form is None else form.causal
+
+    def _eff_lookback(self) -> int | None:
+        form = self._mask_form()
+        return self.max_lookback_seq_len if form is None else form.window
+
+    def _certify_mask(self, n: int) -> None:
+        """Trace-time certificate for the grids this call's strategy
+        lowers the mask to — proven on first use, cached by (mask,
+        shape, blocks, strategy, layout) next to the compile cache."""
+        if self.mask is None:
+            return
+        ring = (self.use_ring and not self.force_regular_attn
+                and self._ring_size() > 1)
+        if not ring:
+            strategy, ring_size = "single", 1
+        elif self.sequence_parallel == "hybrid":
+            strategy = ("counter" if self.ring_counter_rotate else "ring")
+            ring_size = self._ring_size() // self._ulysses_size()
+        elif self.sequence_parallel == "ring":
+            strategy = ("counter" if self.ring_counter_rotate else "ring")
+            ring_size = self._ring_size()
+        else:  # ulysses attends the full span locally; zigzag is
+            strategy, ring_size = "single", 1  # causal-only (own row)
+        passes = None
+        if strategy in ("ring", "counter") and ring_size > 1:
+            _, _, _, passes = self._ring_leg(n // ring_size)
+        mask_algebra.require_certified(
+            self.mask,
+            mask_algebra.spec_for_call(
+                strategy, n=n, ring=ring_size, striped=self.striped,
+                passes=passes,
+            ),
+        )
 
     def _use_pallas(self) -> bool:
         """Resolve the kernel path for this call (trace time, cached probe)."""
@@ -244,8 +312,31 @@ class RingAttention(nn.Module):
         if ring:
             self._check_mesh()
         if self.sequence_parallel == "zigzag":
-            assert self.causal, "zig-zag CP is causal-only (ref zig_zag_attention.py:102-103)"
-            assert self.max_lookback_seq_len is None, "lookback not supported with zigzag"
+            assert self._eff_causal(), "zig-zag CP is causal-only (ref zig_zag_attention.py:102-103)"
+            assert self._eff_lookback() is None, "lookback not supported with zigzag"
+
+        form = self._mask_form()
+        if form is not None:
+            if form.needs_segment_ids and segment_ids is None:
+                raise ValueError(
+                    "RingAttention: the mask includes Segments() — pass "
+                    "the runtime segment_ids array"
+                )
+            if form.doc_starts is not None:
+                if segment_ids is not None:
+                    raise ValueError(
+                        "RingAttention: the mask declares a DocumentMask "
+                        "layout AND segment_ids were passed — declare "
+                        "one packing"
+                    )
+                if ring:
+                    # sequence-parallel paths realize the declared layout
+                    # as runtime ids (padded/permuted/rotated by the
+                    # existing proven machinery); the local Pallas path
+                    # keeps doc_starts for its trace-time compact grid
+                    segment_ids = _doc_runtime_ids(
+                        form.doc_starts, x.shape[1], x.shape[0]
+                    )
 
         n_orig = x.shape[1]
         scheme, factor = self._layout()
@@ -273,8 +364,9 @@ class RingAttention(nn.Module):
 
         q, k, v = self._project_qkv(x)
         b, n, _ = x.shape
+        self._certify_mask(n)
 
-        if self.causal:
+        if self._eff_causal():
             mask = None  # ref asserts causal and key-pad mask are exclusive
 
         if ring:
@@ -296,24 +388,32 @@ class RingAttention(nn.Module):
             freqs = rotary_freqs(jnp.arange(n), self.dim_head, self.rotary_theta)
             q = apply_rotary(q, freqs)
             k = apply_rotary(k, freqs)
-        window = self.max_lookback_seq_len
+        window = self._eff_lookback()
+        causal = self._eff_causal()
+        # a mask-declared packing: doc_starts feed the Pallas compact
+        # grid directly; the XLA/oracle paths realize them as runtime ids
+        form = self._mask_form()
+        doc_starts = (form.doc_starts
+                      if form is not None and segment_ids is None else None)
+        doc_ids = (None if doc_starts is None
+                   else _doc_runtime_ids(doc_starts, n, q.shape[0]))
         if self.force_regular_attn and window is None:
             return default_attention(
-                q, k, v, mask, causal=self.causal,
+                q, k, v, mask, causal=causal,
                 softclamp_value=self.softclamp_value,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids if doc_ids is None else doc_ids,
             )
         if self._use_pallas():
             return pallas_flash_attention(
-                q, k, v, mask, causal=self.causal, window=window,
+                q, k, v, mask, causal=causal, window=window,
                 softclamp_value=self.softclamp_value,
                 head_chunks=self.pallas_head_chunks,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids, doc_starts=doc_starts,
             )
         return flash_attention(
-            q, k, v, mask, causal=self.causal, bucket_size=self.bucket_size,
+            q, k, v, mask, causal=causal, bucket_size=self.bucket_size,
             window=window, softclamp_value=self.softclamp_value,
-            segment_ids=segment_ids,
+            segment_ids=segment_ids if doc_ids is None else doc_ids,
         )
 
     def _sp_attend(self, q, k, v, mask, segment_ids=None):
@@ -353,9 +453,9 @@ class RingAttention(nn.Module):
         bidirectional = self._bidirectional(n_chunk)
         max_ring_passes = None
         window = None
-        lookback = self.max_lookback_seq_len
+        lookback = self._eff_lookback()
         if lookback is not None:
-            assert self.causal, (
+            assert self._eff_causal(), (
                 "max_lookback_seq_len requires causal attention "
                 "(ref ring_flash_attention.py:99)"
             )
@@ -413,10 +513,10 @@ class RingAttention(nn.Module):
                 k = apply_rotary(k, freqs)
             return ulysses_attention(
                 q, k, v, SEQ_AXIS,
-                causal=self.causal,
+                causal=self._eff_causal(),
                 kv_mask=mask,
                 bucket_size=self.bucket_size,
-                window=self.max_lookback_seq_len,
+                window=self._eff_lookback(),
                 softclamp_value=self.softclamp_value,
                 impl="pallas" if self._use_pallas() else "xla",
                 segment_ids=seg,
@@ -463,7 +563,7 @@ class RingAttention(nn.Module):
                 q_r, k_r = q, k
             return hybrid_attention(
                 q_r, k_r, v, mask, ULYSSES_AXIS, RING_AXIS,
-                causal=self.causal, striped=self.striped,
+                causal=self._eff_causal(), striped=self.striped,
                 bucket_size=bucket, max_ring_passes=max_ring_passes,
                 window=window, softclamp_value=self.softclamp_value,
                 impl="pallas" if self._use_pallas() else "xla",
@@ -503,7 +603,7 @@ class RingAttention(nn.Module):
                 q_r, k_r = q, k
             return ring_flash_attention(
                 q_r, k_r, v, mask, SEQ_AXIS,
-                self.causal, self.striped,
+                self._eff_causal(), self.striped,
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
                 "pallas" if self._use_pallas() else "xla",
@@ -625,8 +725,9 @@ class RingAttention(nn.Module):
         the last ``max_lookback_seq_len`` tokens when configured.  ``idx``
         are absolute token positions (the ring path's contiguous shards)."""
         keep = idx <= pos
-        if self.max_lookback_seq_len is not None:
-            keep = keep & (idx > pos - self.max_lookback_seq_len)
+        lookback = self._eff_lookback()
+        if lookback is not None:
+            keep = keep & (idx > pos - lookback)
         return jnp.broadcast_to(keep[None, :], (batch, idx.shape[0]))
 
     def _buffer_mask(self, size: int, pos: jax.Array, batch: int) -> jax.Array:
@@ -640,8 +741,9 @@ class RingAttention(nn.Module):
         s = jnp.arange(size)
         p = pos - ((pos - s) % size)
         keep = p >= 0
-        if self.max_lookback_seq_len is not None:
-            keep = keep & (p > pos - self.max_lookback_seq_len)
+        lookback = self._eff_lookback()
+        if lookback is not None:
+            keep = keep & (p > pos - lookback)
         return jnp.broadcast_to(keep[None, :], (batch, size))
 
     def prefill(
@@ -668,12 +770,12 @@ class RingAttention(nn.Module):
             # decode steps never look further back than that).  Not an
             # assert: under python -O a silently-truncated global-attention
             # cache would produce wrong logits with no error
-            if (self.max_lookback_seq_len is None
-                    or size < self.max_lookback_seq_len):
+            if (self._eff_lookback() is None
+                    or size < self._eff_lookback()):
                 raise ValueError(
                     f"prefill: prompt ({n}) longer than the cache ({size}) "
                     f"is only valid for a window-sized cache covering "
-                    f"max_lookback_seq_len ({self.max_lookback_seq_len})"
+                    f"max_lookback_seq_len ({self._eff_lookback()})"
                 )
         q, k, v = self._project_qkv(x)
         if self.rotary:
@@ -687,7 +789,7 @@ class RingAttention(nn.Module):
         else:
             out = flash_attention(
                 q, k, v, causal=True, bucket_size=self.bucket_size,
-                window=self.max_lookback_seq_len,
+                window=self._eff_lookback(),
                 softclamp_value=self.softclamp_value,
             )
         if n > size:
@@ -741,8 +843,8 @@ class RingAttention(nn.Module):
         bidirectional = self._bidirectional(n_local)
         max_ring_passes = None
         window = None
-        if self.max_lookback_seq_len is not None:
-            window = self.max_lookback_seq_len
+        if self._eff_lookback() is not None:
+            window = self._eff_lookback()
             max_ring_passes = math.ceil((window - 1) / n_local) + 1
 
         def core(q, k, v):

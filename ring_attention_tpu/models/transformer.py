@@ -45,6 +45,7 @@ from ..parallel.sharding import (
 )
 from ..utils.validate import check_tokens_input
 from .attention import RingAttention
+from .. import masks as mask_algebra
 from .layers import FeedForward, RMSNorm
 from .remat import REMAT_POLICIES, resolve_remat_policy
 
@@ -74,6 +75,13 @@ class RingTransformer(nn.Module):
     dim: int
     depth: int
     causal: bool = False
+    # mask-algebra expression (ring_attention_tpu.masks), forwarded to
+    # every attention layer: ``causal=True`` is sugar for
+    # ``mask=Causal()``; a tuple selects per layer (mirroring
+    # max_lookback_seq_len — e.g. local-window layers below a global
+    # one).  Certified at trace time per layer; mutually exclusive with
+    # causal=True and max_lookback_seq_len (see RingAttention.mask)
+    mask: mask_algebra.Mask | tuple[mask_algebra.Mask | None, ...] | None = None
     heads: int = 8
     dim_head: int = 64
     kv_heads: int | None = None
@@ -194,6 +202,7 @@ class RingTransformer(nn.Module):
                 rotary=self.rotary,
                 softclamp_value=self.softclamp_value,
                 max_lookback_seq_len=lookback,
+                mask=layer_mask,
                 auto_shard=False,  # sharded once at model top
                 mesh=self.mesh,
                 use_pallas=self.use_pallas,
@@ -207,7 +216,9 @@ class RingTransformer(nn.Module):
                 ring_hop_compression=self.ring_hop_compression,
                 dtype=self.dtype,
             )
-            for attn_cls, lookback in zip(attn_classes, self._lookbacks())
+            for attn_cls, lookback, layer_mask in zip(
+                attn_classes, self._lookbacks(), self._masks()
+            )
         ]
         self.ff_layers = [
             ff_cls(
@@ -247,6 +258,30 @@ class RingTransformer(nn.Module):
             lb = (lb,) * self.depth
         assert len(lb) == self.depth
         return lb
+
+    def _masks(self) -> tuple[mask_algebra.Mask | None, ...]:
+        m = self.mask
+        if not isinstance(m, tuple):
+            m = (m,) * self.depth
+        if len(m) != self.depth:
+            raise ValueError(
+                f"RingTransformer: mask tuple has {len(m)} entries for "
+                f"depth {self.depth} (one mask per layer, or a single "
+                f"mask for all layers)"
+            )
+        return m
+
+    def _eff_causal(self) -> bool:
+        """Whether every layer's attention is causal — the property the
+        pad-mask synthesis and the zig-zag assert actually rely on
+        (``causal=True`` or a mask whose kernel form is causal)."""
+        if self.mask is None:
+            return self.causal
+        return all(
+            mask_algebra.kernel_form(m).causal if m is not None
+            else self.causal
+            for m in self._masks()
+        )
 
     def _remat_policies(self) -> tuple[str | None, ...]:
         """Per-layer remat-policy names, validated against the registry
@@ -311,13 +346,13 @@ class RingTransformer(nn.Module):
         scheme, factor = self._layout()
         zigzag = self.sequence_parallel == "zigzag" and ring > 1
         if zigzag:
-            assert self.causal, "zig-zag CP is causal-only"
+            assert self._eff_causal(), "zig-zag CP is causal-only"
 
         if ring > 1 and self.auto_shard:
             pad_mult = 2 * ring if zigzag else ring
             tokens, _ = pad_to_multiple(tokens, pad_mult)
             padded = tokens.shape[1] != n_orig
-            if padded and mask is None and not self.causal:
+            if padded and mask is None and not self._eff_causal():
                 # non-causal: real tokens must not attend to the pad slots,
                 # so synthesize a key-padding mask (ref ring_attention.py:211-219);
                 # causal needs none — pad sits after every real query and the

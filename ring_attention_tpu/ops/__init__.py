@@ -26,6 +26,7 @@ from .pallas_flash import (
     quantize_kv_cache,
 )
 from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
+from .. import masks as _masks
 
 
 def attention(
@@ -46,6 +47,19 @@ def attention(
     doc_starts: tuple[int, ...] | None = None,
 ):
     """Single-device attention entry point with graceful kernel degradation.
+
+    ``mask`` accepts either a ``(b, nk)`` boolean key-padding array (the
+    classic form) or a :class:`ring_attention_tpu.masks.Mask` algebra
+    expression — ``attention(q, k, v, mask=Causal() & SlidingWindow(512))``.
+    A mask expression is resolved onto the kernel knobs through
+    ``masks.kernel_form`` (``causal=True`` elsewhere is sugar for
+    ``Causal()``), its lowering is CERTIFIED at trace time
+    (sound/tight/complete against the mask's own oracle —
+    ``masks.require_certified``, cached next to the compile cache), and
+    it subsumes ``causal=`` / ``window=`` / ``doc_starts=`` (passing
+    both raises).  Expressions beyond the kernel surface (prefix-LM,
+    dilated, per-head) raise :class:`~ring_attention_tpu.masks.
+    MaskLoweringError` naming the supported forms.
 
     ``impl`` selects the kernel path:
 
@@ -72,10 +86,45 @@ def attention(
     from ..utils import resilience
     from ..utils.validate import check_attention_args
 
-    # validate BEFORE any fallback machinery: a caller's input error must
-    # raise as itself, never be mistaken for a kernel failure and mark
-    # the Pallas path degraded for the rest of the process
+    attn_mask = None
+    if isinstance(mask, _masks.Mask):
+        attn_mask, mask = mask, None  # the padding-mask slot stays empty
+
+    # validate BEFORE any fallback machinery (or mask resolution, which
+    # reads shapes): a caller's input error must raise as itself, never
+    # be mistaken for a kernel failure and mark the Pallas path degraded
+    # for the rest of the process
     check_attention_args("attention", q, k, v, mask)
+
+    if attn_mask is not None:
+        if causal or window is not None:
+            raise ValueError(
+                "attention: a mask expression subsumes causal=/window= — "
+                "compose them into the mask (causal=True is sugar for "
+                "Causal())"
+            )
+        form = _masks.kernel_form(attn_mask)  # raises MaskLoweringError
+        causal, window = form.causal, form.window
+        if form.doc_starts is not None:
+            if doc_starts is not None:
+                raise ValueError(
+                    "attention: the mask already declares a DocumentMask "
+                    "packing; drop the doc_starts= argument"
+                )
+            doc_starts = form.doc_starts
+        if form.needs_segment_ids and segment_ids is None:
+            raise ValueError(
+                "attention: the mask includes Segments() — pass the "
+                "runtime segment_ids array"
+            )
+        if q.shape[2] == k.shape[2]:
+            # trace-time certificate for the grids this call lowers to,
+            # cached by (mask, shape, blocks, strategy, layout); cross-
+            # attention spans have no self-attention grid to certify
+            _masks.require_certified(
+                attn_mask, _masks.spec_for_call("single", n=q.shape[2])
+            )
+
     if head_chunks is not None and head_chunks > 1:
         h, hk = q.shape[1], k.shape[1]
         if h % head_chunks or hk % head_chunks:

@@ -564,6 +564,45 @@ def test_lint_ra009_host_numpy_in_traced_code():
             lint_source(rng, "ring_attention_tpu/ops/toy.py")] == ["RA005"]
 
 
+def test_lint_ra010_grid_seam_bypass():
+    """RA010: constructing Pallas grid tables or hop skip-predicates
+    outside the band_plan()/mask-algebra seam flags (the bypass that
+    would dodge certification); the seam modules themselves, the
+    certifier, and a reasoned allow are clean."""
+    bad = (
+        "from ring_attention_tpu.ops.pallas_flash import _band_tables\n"
+        "def my_grid():\n"
+        "    return _band_tables(4, 4, 8, 8, (0, 0, 0, 0), False, True)\n"
+    )
+    violations = lint_source(bad, "ring_attention_tpu/parallel/newpath.py")
+    assert [v.rule for v in violations] == ["RA010"]
+    assert "band_plan" in violations[0].message
+    # hop skip-predicates are part of the seam too
+    skip = ("def f(hi, lo):\n"
+            "    return _hop_has_work(hi, lo, 16, 16)\n")
+    assert [v.rule for v in lint_source(
+        skip, "ring_attention_tpu/models/custom.py")] == ["RA010"]
+    # the seam's home modules, the algebra, and the certifier are exempt
+    for seam in ("ring_attention_tpu/ops/pallas_flash.py",
+                 "ring_attention_tpu/parallel/ring.py",
+                 "ring_attention_tpu/masks.py",
+                 "ring_attention_tpu/analysis/coverage.py"):
+        assert lint_source(bad, seam) == [], seam
+    allowed = bad.replace(
+        "(0, 0, 0, 0), False, True)",
+        "(0, 0, 0, 0), False, True)  "
+        "# ra: allow(RA010 prototyping a grid the prover covers in-test)",
+    )
+    assert lint_source(allowed,
+                       "ring_attention_tpu/parallel/newpath.py") == []
+    bare = bad.replace(
+        "(0, 0, 0, 0), False, True)",
+        "(0, 0, 0, 0), False, True)  # ra: allow(RA010)",
+    )
+    [v] = lint_source(bare, "ring_attention_tpu/parallel/newpath.py")
+    assert "reason is mandatory" in v.message
+
+
 # ----------------------------------------------------------------------
 # Self-runs: the package itself is clean
 # ----------------------------------------------------------------------

@@ -41,6 +41,45 @@ def test_coverage_case_sound_and_tight(case):
     assert report.hops > 0 and (report.tiles > 0 or report.name)
 
 
+@pytest.mark.parametrize("case", coverage.MASK_CASES, ids=lambda c: c.name)
+def test_mask_coverage_case_sound_and_tight(case):
+    """Acceptance (PR 11): every mask-algebra row — band masks through
+    the shipping band_plan/ring-hop seams, generic masks (prefix-LM,
+    dilated, per-head, Or/Not compositions) through the algebra's tile
+    classifier — proves sound, tight, and schedule-complete against the
+    mask's own global-position oracle."""
+    report = coverage.prove_mask_case(case)
+    assert report.ok, "\n".join(report.violations)
+    assert report.hops > 0
+
+
+def test_mask_rows_match_legacy_band_rows():
+    """The mask-algebra route re-derives the PR-9 rows bit-for-bit: the
+    same geometries lowered through ``mask=`` produce exactly the legacy
+    matrix's tile accounting (two independent routes, one grid)."""
+    fp = coverage.coverage_fingerprint()
+    for mask_row, legacy_row in [
+        ("mask/single/causal", "single/causal"),
+        ("mask/single/causal-window", "single/causal/window"),
+        ("mask/ring/causal", "ring/contiguous"),
+        ("mask/ring/causal-window", "ring/contiguous/window"),
+        ("mask/ring/striped-window", "ring/striped/window"),
+        ("mask/ring/limited-passes", "ring/limited-passes"),
+        ("mask/counter/causal", "counter/contiguous"),
+        ("mask/counter/window", "counter/window"),
+    ]:
+        assert fp[mask_row] == fp[legacy_row], (mask_row, legacy_row)
+
+
+def test_coverage_matrix_is_enlarged():
+    """Acceptance: the enlarged matrix holds >= 30 rows and is a strict
+    superset of the original 16."""
+    reports = coverage.run_coverage_suite()
+    assert len(reports) >= 30
+    names = {r.name for r in reports}
+    assert {c.name for c in coverage.CASES} | {"zigzag/causal"} <= names
+
+
 def test_coverage_zigzag_rect_grid():
     """The zig-zag path's rectangular-grid predicates (traced offsets, no
     tables) against the same oracle — including the ~half tile skip the
@@ -126,10 +165,11 @@ def test_coverage_fingerprint_deterministic_and_ok():
     assert fp1 == fp2
     assert fp1["coverage_ok"] is True
     assert fp1["single/causal"]["tiles"] == 36
-    # every matrix row lands in the fingerprint
+    # every matrix row lands in the fingerprint — the fixed strategy x
+    # layout x masking rows, zig-zag, and the mask-algebra rows
     assert set(fp1) - {"coverage_ok"} == {
         c.name for c in coverage.CASES
-    } | {"zigzag/causal"}
+    } | {"zigzag/causal"} | {c.name for c in coverage.MASK_CASES}
 
 
 def test_gate_catches_coverage_regression(tmp_path):

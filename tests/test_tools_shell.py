@@ -208,6 +208,32 @@ def test_check_contracts_coverage_exits_zero():
     assert "coverage rows sound and tight" in proc.stdout
 
 
+def test_check_contracts_mask_filter():
+    """``--coverage --mask EXPR`` re-proves one mask row in isolation;
+    an unknown mask name lists the registry instead of tracebacking."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--coverage", "--mask",
+         "causal&window:24"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "(causal&window:24)" in proc.stdout
+    assert "coverage rows sound and tight" in proc.stdout
+    bad = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--coverage", "--mask", "wat:7"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert bad.returncode != 0
+    assert "Traceback" not in bad.stderr
+    assert "registry" in bad.stderr and "window" in bad.stderr
+    # --mask without --coverage is a usage error, not a silent no-op
+    usage = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--mask", "causal"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert usage.returncode != 0 and "--coverage" in usage.stderr
+
+
 def test_check_contracts_knows_counter_variants():
     """The counter-rotation / int8-compression strategies are enumerable
     by name: an unknown strategy's error message lists every CONTRACTS

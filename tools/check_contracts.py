@@ -95,6 +95,16 @@ def main(argv: list[str] | None = None) -> int:
                              "soundness/tightness/schedule completeness "
                              "per strategy x layout x masking row) "
                              "instead of the collective contracts")
+    parser.add_argument("--mask", default=None, metavar="EXPR",
+                        help="with --coverage: re-prove ONE mask-algebra "
+                             "row in isolation — a textual mask "
+                             "expression like 'causal&window:512' or "
+                             "'prefix:128|docs:0,64' (leaves: full, "
+                             "causal, window:W, prefix:P, dilated:S[+O], "
+                             "docs:a,b,..., segments, perhead(a;b); "
+                             "combinators & | ~ and parentheses), "
+                             "lowered and certified on the standard "
+                             "single/ring/counter geometries")
     parser.add_argument("--dataflow", action="store_true",
                         help="run the jaxpr dataflow passes (precision-"
                              "flow audit + SPMD divergence checker) "
@@ -108,10 +118,52 @@ def main(argv: list[str] | None = None) -> int:
     )
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    if args.mask is not None and not args.coverage:
+        raise SystemExit("--mask re-proves a coverage row; add --coverage")
+
     if args.coverage:
         from ring_attention_tpu.analysis.coverage import run_coverage_suite
 
-        reports = run_coverage_suite()
+        if args.mask is not None:
+            from ring_attention_tpu.analysis.coverage import (
+                MaskCoverageCase,
+                prove_mask_case,
+            )
+            from ring_attention_tpu.masks import MaskParseError, parse_mask
+
+            try:
+                expr = parse_mask(args.mask).key
+            except MaskParseError as e:
+                # unknown names list the registry, not a traceback
+                print(f"--mask {args.mask!r}: {e}", file=sys.stderr)
+                return 2
+            geometries = [
+                ("single", "contiguous", 1, 64, 8),
+                ("ring", "contiguous", 4, 16, 4),
+                ("counter", "contiguous", 4, 16, 4),
+            ]
+            from ring_attention_tpu.masks import MaskLoweringError
+
+            reports = []
+            for strategy, layout, ring, n_local, block in geometries:
+                try:
+                    reports.append(prove_mask_case(MaskCoverageCase(
+                        name=f"mask/{strategy}/{expr}", expr=args.mask,
+                        strategy=strategy, layout=layout, ring=ring,
+                        n_local=n_local, block=block,
+                    )))
+                except MaskLoweringError as e:
+                    # e.g. a striped/generic combination with no lowering
+                    # on this geometry — skipped loudly, other errors raise
+                    print(f"skip mask/{strategy}: {e}", file=sys.stderr)
+            if not reports:
+                # every geometry skipped = nothing was proven; exiting 0
+                # here would let an unproven mask read as certified
+                print(f"--mask {args.mask!r}: no geometry produced a "
+                      f"lowering — nothing was proven", file=sys.stderr)
+                return 2
+        else:
+            reports = run_coverage_suite()
         failed = [r for r in reports if not r.ok]
         if args.json:
             print(json.dumps({
