@@ -1139,6 +1139,132 @@ def _last_measured() -> dict:
     return latest
 
 
+class ProbeKilled(RuntimeError):
+    """The device-probe child exceeded its hard deadline and was killed
+    (SIGKILL to its whole process group — a wedged tunnel can leave
+    grandchildren holding the TPU lockfile, so killing just the child
+    is not enough)."""
+
+
+def _kill_probe_group(proc) -> None:
+    import signal as _signal
+
+    try:
+        os.killpg(proc.pid, _signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        proc.kill()
+    try:  # reap; never hang the parent on a corpse
+        proc.communicate(timeout=10)
+    except Exception:  # noqa: BLE001 — already killed; nothing to salvage
+        pass
+
+
+def _probe_device() -> str:
+    """One killable device-probe attempt with a hard wall-clock deadline.
+
+    The child runs in its OWN session (``start_new_session``) so a
+    deadline overrun kills the whole process group, not just the python
+    shim — the round 3-5 wedge survived ``subprocess.run(timeout=...)``
+    because the hang was below the child.  ``BENCH_PROBE_DEADLINE_S``
+    sets the deadline (default 180); ``BENCH_PROBE_WEDGE_S`` makes the
+    child sleep first — the chaos harness's wedge simulation, so the
+    kill path is testable on any backend (tests/test_elastic.py).
+    """
+    deadline = float(os.environ.get("BENCH_PROBE_DEADLINE_S", 180))
+    code = (
+        "import os, time; "
+        "time.sleep(float(os.environ.get('BENCH_PROBE_WEDGE_S') or 0)); "
+        "import jax; print(jax.devices()[0].platform)"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        _kill_probe_group(proc)
+        raise ProbeKilled(
+            f"device probe exceeded its {deadline:.0f}s hard deadline; "
+            f"process group killed (TPU tunnel unresponsive)"
+        ) from None
+    if proc.returncode != 0:
+        raise RuntimeError(f"device probe failed: {err[-300:]}")
+    return out.strip()
+
+
+def _run_probe() -> dict:
+    """Retry ladder around :func:`_probe_device` (utils/resilience.py):
+    a transient blip gets one backed-off retry, a wedge costs exactly one
+    deadline per attempt (the child is killed, never awaited), and the
+    verdict records whether a kill happened (the structured
+    ``probe_failure`` row keeps it queryable)."""
+    res = _load_repo_module(
+        "_bench_resilience", "ring_attention_tpu", "utils", "resilience.py"
+    )
+    deadline = float(os.environ.get("BENCH_PROBE_DEADLINE_S", 180))
+    try:
+        res.with_retries(
+            _probe_device,
+            timeout=deadline + 60,  # backstop over the child's own kill
+            backoff=float(os.environ.get("BENCH_PROBE_BACKOFF_S", 30)),
+            max_attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2)),
+        )
+    except res.RetryError as e:
+        if isinstance(e.last, ProbeKilled):
+            return {
+                "ok": False,
+                "killed": True,
+                "error": (
+                    f"device probe hung (TPU tunnel unresponsive; child "
+                    f"killed after {deadline:.0f}s hard deadline)"
+                ),
+            }
+        if isinstance(e.last, (subprocess.TimeoutExpired, TimeoutError)):
+            # the WRAPPER's backstop fired, not the child's deadline: the
+            # child was NOT killed (the thread owning its handle was
+            # abandoned) and may still be running — say so truthfully
+            # instead of asserting a kill that never happened
+            return {
+                "ok": False,
+                "killed": False,
+                "error": (
+                    f"device probe hung past the wrapper backstop "
+                    f"({deadline + 60:.0f}s); child not confirmed killed "
+                    f"and may still be running"
+                ),
+            }
+        return {"ok": False, "killed": False, "error": str(e.last)}
+    return {"ok": True}
+
+
+def _wedge_streak(path: str | None = None) -> int:
+    """Length of the trailing run of consecutive ``probe_failure`` rows
+    in the hardware log — the wedge-streak number surfaced in the BENCH
+    tail, so "how long has this tunnel been down" is one field instead
+    of an archaeology session over results.jsonl."""
+    path = path or os.environ.get("BENCH_HWLOG") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "hwlogs", "results.jsonl",
+    )
+    streak = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("step") == "probe_failure":
+                    streak += 1
+                else:
+                    streak = 0
+    except OSError:
+        return 0
+    return streak
+
+
 def _log_probe_failure(probe: dict) -> None:
     """Append a structured probe-failure row to the hardware results log.
 
@@ -1161,6 +1287,9 @@ def _log_probe_failure(probe: dict) -> None:
         "result": {
             "error": probe.get("error", "device probe failed"),
             "cached": bool(probe.get("cached")),
+            # whether the hard deadline killed the probe's process group
+            # (a wedge) vs the probe failing on its own (a real error)
+            "killed": bool(probe.get("killed")),
             **({"age_s": probe["age_s"]} if probe.get("cached") else {}),
             "env": probe.get("env", ""),
         },
@@ -1235,42 +1364,13 @@ def main() -> None:
     }
     # fast health gate: this image's TPU tunnel can wedge such that even
     # jax.devices() hangs; don't burn the full fallback budget in that
-    # state.  The probe runs through the shared retry/timeout/backoff
-    # helper (utils/resilience.py): per-attempt subprocess timeout kills a
-    # hung child, the wrapper's own timeout is the backstop for a wedged
-    # subprocess layer, and a transient tunnel blip gets one backed-off
-    # retry before the round is declared wedged.  On failure the emitted
-    # JSON is unchanged: error + last_measured standing numbers, so a
-    # wedged round still never reads as "this framework benches 0.0".
-    _resilience = _load_repo_module(
-        "_bench_resilience", "ring_attention_tpu", "utils", "resilience.py"
-    )
-    RetryError, with_retries = _resilience.RetryError, _resilience.with_retries
-
-    def _probe_device():
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=180,
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(f"device probe failed: {proc.stderr[-300:]}")
-        return proc
-
-    def _run_probe():
-        try:
-            with_retries(
-                _probe_device,
-                timeout=240,  # backstop over the subprocess's own 180s kill
-                backoff=float(os.environ.get("BENCH_PROBE_BACKOFF_S", 30)),
-                max_attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2)),
-            )
-        except RetryError as e:
-            if isinstance(e.last, (subprocess.TimeoutExpired, TimeoutError)):
-                return {"ok": False, "error": (
-                    "device probe hung (TPU tunnel unresponsive after 180s)"
-                )}
-            return {"ok": False, "error": str(e.last)}
-        return {"ok": True}
+    # state.  The probe (module-level _probe_device/_run_probe) runs in a
+    # KILLABLE subprocess session with a hard deadline — a wedged tunnel
+    # costs one deadline per attempt, never a hung round — through the
+    # shared retry/backoff helper (utils/resilience.py).  On failure the
+    # emitted JSON is unchanged: error + last_measured standing numbers +
+    # wedge_streak, so a wedged round still never reads as "this
+    # framework benches 0.0".
 
     # phase 0 — collective fingerprint (CPU-only, before the TPU probe so
     # it lands even on wedged rounds): per-strategy collective counts from
@@ -1337,6 +1437,9 @@ def main() -> None:
         result["error"] = err
         result["last_measured"] = _last_measured()
         _log_probe_failure(probe)
+        # after appending this round's row: the streak INCLUDES it, so
+        # the tail says "wedged N rounds running" in one field
+        result["wedge_streak"] = _wedge_streak()
         print(json.dumps(result))
         return
 

@@ -98,6 +98,15 @@ def main() -> None:
     ap.add_argument("--ckpt-every", type=int, default=5)
     ap.add_argument("--ckpt-keep", type=int, default=3,
                     help="keep-last-N checkpoint retention")
+    ap.add_argument("--elastic-ckpt", action="store_true",
+                    help="elastic runtime (docs/resilience.md): sharded "
+                         "ASYNC checkpoints (one file per shard group, "
+                         "atomic manifest commit), SIGTERM/SIGINT drain "
+                         "(finish the step, save, dump a flight "
+                         "incident, exit cleanly), and re-mesh resume — "
+                         "restart this command at a DIFFERENT device "
+                         "count and it reshards the checkpoint onto the "
+                         "new mesh (requires --ckpt-dir)")
     ap.add_argument("--skip-nonfinite", action="store_true",
                     help="guarded train step: skip (don't apply) optimizer "
                          "updates whose loss/grads are non-finite")
@@ -124,6 +133,8 @@ def main() -> None:
     args = ap.parse_args()
     if args.log_every < 1:
         ap.error("--log-every must be >= 1")
+    if args.elastic_ckpt and not args.ckpt_dir:
+        ap.error("--elastic-ckpt needs --ckpt-dir")
 
     if args.fake_devices:
         os.environ["XLA_FLAGS"] = (
@@ -164,6 +175,41 @@ def main() -> None:
     )
 
     n_dev = len(jax.devices())
+
+    # elastic resume plans the mesh BEFORE building it: when the job
+    # comes back at a different device count and no explicit factoring
+    # was requested, the checkpoint manifest's mesh descriptor + the new
+    # world pick the closest factoring (ring absorbs the change)
+    elastic_mgr = None
+    guard = None
+    if args.elastic_ckpt:
+        from ring_attention_tpu.elastic import (
+            ElasticCheckpointManager,
+            PreemptionGuard,
+        )
+        from ring_attention_tpu.parallel import remesh_plan
+
+        elastic_mgr = ElasticCheckpointManager(
+            args.ckpt_dir, keep=args.ckpt_keep
+        )
+        manifest = elastic_mgr.latest_manifest()
+        if (manifest is not None and args.ring_size is None
+                and args.ulysses_size is None):
+            plan, diags = remesh_plan(manifest.get("mesh"), n_dev)
+            for line in diags:
+                print(f"  {line}")
+            args.ring_size = plan.get("ring_size")
+            args.ulysses_size = plan.get("ulysses_size")
+        # constructed here, INSTALLED just before the train loop: during
+        # the multi-minute init/compile/restore window a latched signal
+        # would get no drain check, so the default Ctrl-C behavior is
+        # the right response there.  The handler prints on first signal
+        # so a drain never looks like a hang.
+        guard = PreemptionGuard(on_preempt=lambda sig: print(
+            f"\n{sig} received: finishing the in-flight step, then "
+            f"draining (save + incident dump); signal again to abort"
+        ))
+
     ulysses = args.ulysses_size or 1
     hybrid = ulysses > 1
     if hybrid:
@@ -277,7 +323,9 @@ def main() -> None:
     stats = init_step_stats()
     nonfinite = jnp.asarray(0, jnp.int32)
     if args.ckpt_dir:
-        mgr = CheckpointManager(args.ckpt_dir, keep=args.ckpt_keep)
+        mgr = elastic_mgr or CheckpointManager(
+            args.ckpt_dir, keep=args.ckpt_keep
+        )
         # stats ride along in the checkpoint so a resumed guarded run
         # keeps its skipped-step telemetry (a growing skip streak is the
         # "this run diverged" signal and must survive preemption).  With
@@ -291,7 +339,18 @@ def main() -> None:
                 state["nonfinite"] = nonfinite
             return state
 
-        state, start = mgr.resume_or_init(fresh)
+        if elastic_mgr is not None:
+            # elastic resume: resharded-loads the checkpoint onto the
+            # CURRENT mesh (whatever factoring it was written at) and
+            # revalidates seq_len divisibility with a one-line error
+            state, start = mgr.resume_or_init(
+                fresh, mesh=mesh, seq_len=args.seq_len
+            )
+            if mgr.last_resume is not None:
+                for line in mgr.last_resume["diagnostics"]:
+                    print(f"  {line}")
+        else:
+            state, start = mgr.resume_or_init(fresh)
         params, opt_state = state["params"], state["opt_state"]
         stats = state["stats"]
         nonfinite = state.get("nonfinite", nonfinite)
@@ -372,10 +431,18 @@ def main() -> None:
     loop_guard = recorder.guard() if recorder is not None else (
         contextlib.nullcontext()
     )
-    with loop_guard:
-        _train_loop(args, recorder, timer, train_step, params, opt_state,
-                    metrics, stats, batch, collect, guarded, mgr, logger,
-                    start, mfu_flops, comms, peak)
+    try:
+        if guard is not None:
+            guard.install()  # compile/init/restore are behind us
+        with loop_guard:
+            _train_loop(args, recorder, timer, train_step, params,
+                        opt_state, metrics, stats, batch, collect, guarded,
+                        mgr, logger, start, mfu_flops, comms, peak, guard)
+    finally:
+        if elastic_mgr is not None:
+            elastic_mgr.close()  # flush any in-flight async save
+        if guard is not None:
+            guard.uninstall()
     if logger is not None:
         logger.close()
         print(f"metrics: {logger.path} (render with tools/trace_report.py)")
@@ -385,9 +452,15 @@ def main() -> None:
 
 def _train_loop(args, recorder, timer, train_step, params, opt_state,
                 metrics, stats, batch, collect, guarded, mgr, logger,
-                start, mfu_flops, comms, peak):
+                start, mfu_flops, comms, peak, guard=None):
     from ring_attention_tpu.utils import achieved_mfu
     from ring_attention_tpu.utils.train import StepStats
+
+    def make_ckpt():
+        ckpt = {"params": params, "opt_state": opt_state, "stats": stats}
+        if collect:
+            ckpt["nonfinite"] = metrics.nonfinite
+        return ckpt
 
     for step in range(start, args.steps):
         if collect:
@@ -435,14 +508,22 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
                     ) if sps > 0 else 0.0,
                     **comms,
                 )
+        if guard is not None and guard.should_stop():
+            # preemption drain: this step FINISHED (we're at the step
+            # boundary); save synchronously, dump the incident with its
+            # trajectory, and leave the loop cleanly — the restarted job
+            # resumes at step + 1, possibly at another device count
+            guard.drain(
+                lambda: mgr.save(step, make_ckpt(), block=True),
+                recorder=recorder, step=step,
+            )
+            print(f"preemption ({guard.signal_name}): drained and saved "
+                  f"step {step}; exiting cleanly")
+            break
         if mgr is not None and (
             step % args.ckpt_every == 0 or step == args.steps - 1
         ):
-            ckpt = {"params": params, "opt_state": opt_state,
-                    "stats": stats}
-            if collect:
-                ckpt["nonfinite"] = metrics.nonfinite
-            mgr.save(step, ckpt)
+            mgr.save(step, make_ckpt())
 
 
 if __name__ == "__main__":

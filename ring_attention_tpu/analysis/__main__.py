@@ -5,7 +5,8 @@ Lints the whole package tree, runs the f32 accumulator-dtype spot audit
 ``--no-dataflow``: the precision-flow auditor over both flash paths /
 the int8 hop chain / the counter bwd pack, and the SPMD divergence
 checker over every strategy when multiple simulated devices are
-available), the tile-coverage prover (unless ``--no-coverage``), and
+available), the tile-coverage prover (unless ``--no-coverage``), the
+elastic checkpoint contracts (unless ``--no-elastic``), and
 the perf-observatory gate (unless ``--no-gate``): benchmark-history
 trend checks plus the arithmetic comms-reference table and the coverage
 fingerprint against ``docs/perf_baseline.json``.  The default gate pass
@@ -42,11 +43,11 @@ def _request_virtual_devices(n: int = 8) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _have_virtual_devices() -> bool:
+def _have_virtual_devices(n: int = 2) -> bool:
     import jax
 
     try:
-        return len(jax.devices()) >= 2
+        return len(jax.devices()) >= n
     except Exception:  # noqa: BLE001 — no backend at all: skip, don't crash
         return False
 
@@ -66,6 +67,10 @@ def main(argv: list[str] | None = None) -> int:
                              "divergence passes")
     parser.add_argument("--no-coverage", action="store_true",
                         help="skip the tile-coverage prover")
+    parser.add_argument("--no-elastic", action="store_true",
+                        help="skip the elastic checkpoint contracts "
+                             "(manifest round-trip, resharded==direct "
+                             "load, corrupt-shard fallback, debris sweep)")
     parser.add_argument("--no-gate", action="store_true",
                         help="skip the perf gate (history + comms baseline)")
     parser.add_argument("--gate-full", action="store_true",
@@ -99,6 +104,19 @@ def main(argv: list[str] | None = None) -> int:
 
         for report in coverage.run_coverage_suite():
             failures.extend(report.violations)
+    if not args.no_elastic:
+        # the elastic checks build 4-device sub-meshes
+        if _have_virtual_devices(4):
+            from ..elastic.verify import run_elastic_suite
+
+            for name, violations in run_elastic_suite():
+                failures.extend(f"{name}: {v}" for v in violations)
+        else:
+            notes.append(
+                "elastic checks skipped: backend already initialized "
+                "with < 4 devices (tools/check_contracts.py --elastic "
+                "runs them with virtual devices)"
+            )
     if not args.no_gate:
         if args.gate_full:
             current = perfgate.collect_current()

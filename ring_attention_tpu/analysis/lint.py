@@ -59,6 +59,17 @@ past PR, with the shim/convention that prevents it:
          certification.  New grids go through ``band_plan()`` or the
          mask algebra, which certify; anything else carries a reasoned
          allow.
+  RA011  signal/process-kill primitives (``signal.signal`` /
+         ``signal.setitimer`` / ``os.kill`` / ``os.killpg`` /
+         ``os._exit``) outside the elastic runtime (``elastic/``) or
+         ``utils/resilience.py``.  Preemption semantics — drain the
+         in-flight step, save, dump the incident, THEN exit — live in
+         ``elastic.PreemptionGuard``; an ad-hoc ``signal.signal``
+         elsewhere silently replaces the guard's handler and a stray
+         ``os.kill``/``os._exit`` bypasses the drain entirely (the
+         chaos harness's hard-death points are the ONE sanctioned
+         user).  Legitimate uses elsewhere (liveness probes) carry a
+         reasoned allow.
 
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
@@ -120,6 +131,20 @@ GRID_SEAM_MODULES = (
 # RA008: metric-name unit suffixes (docs/observability.md glossary)
 METRIC_UNIT_SUFFIXES = ("_bytes", "_sec", "_count", "_frac")
 
+# RA011: signal-handling / process-kill primitives, and the modules that
+# own preemption semantics (the elastic runtime + the resilience layer).
+SIGNAL_CALLS = {
+    "signal.signal",
+    "signal.setitimer",
+    "os.kill",
+    "os.killpg",
+    "os._exit",
+}
+SIGNAL_MODULES = (
+    "ring_attention_tpu/elastic/",
+    "utils/resilience.py",
+)
+
 _ALLOW_RE = re.compile(r"#\s*ra:\s*allow\(\s*(RA\d{3})\b([^)]*)\)")
 
 
@@ -167,6 +192,9 @@ class _Linter(ast.NodeVisitor):
         self.is_shim = rel.replace("\\", "/").endswith(SHIM_MODULE)
         self.in_grid_seam = any(
             m in rel.replace("\\", "/") for m in GRID_SEAM_MODULES
+        )
+        self.in_signal_scope = any(
+            m in rel.replace("\\", "/") for m in SIGNAL_MODULES
         )
         self.traced_pkg = any(
             rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
@@ -245,6 +273,15 @@ class _Linter(ast.NodeVisitor):
                       "band_plan()/mask-algebra seam — this skip grid "
                       "dodges the coverage certifier; lower through "
                       "band_plan() or ring_attention_tpu.masks")
+
+        if isinstance(func, ast.Attribute) and not self.in_signal_scope:
+            sig_chain = _attr_chain(func)
+            if sig_chain in SIGNAL_CALLS:
+                self.flag(node, "RA011",
+                          f"{sig_chain}() outside elastic//resilience.py — "
+                          "preemption semantics (drain, save, incident "
+                          "dump) live in elastic.PreemptionGuard/chaos; "
+                          "an ad-hoc handler or kill bypasses the drain")
 
         if name in COLLECTIVE_CALLS and self.scope_depth == 0:
             self.flag(node, "RA004",
@@ -385,7 +422,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA010)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA011)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

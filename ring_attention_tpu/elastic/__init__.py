@@ -1,0 +1,42 @@
+"""Elastic training runtime: survive preemption, resume at any world.
+
+Three cooperating pieces (docs/resilience.md is the operator guide):
+
+- :class:`ElasticCheckpointManager` — sharded, async, double-buffered
+  checkpoints committed by one atomic manifest rename; restore
+  re-scatters every leaf onto the CURRENT mesh, so a job can come back
+  at a different device count (``elastic/checkpoint.py``).
+- :class:`PreemptionGuard` — SIGTERM/SIGINT becomes a drain: finish the
+  in-flight step, save, dump a FlightRecorder incident, exit cleanly
+  (``elastic/preemption.py``).
+- :mod:`~ring_attention_tpu.elastic.chaos` — the process-level fault
+  harness that proves both: hard-death points inside the commit
+  protocol, injected delays for wedge simulation, file corruption, and
+  a multi-process virtual-device runner (``elastic/chaos.py``).
+
+``tools/check_contracts.py --elastic`` runs the machine-checked
+contracts (``elastic/verify.py``).
+"""
+
+from . import chaos
+from .checkpoint import (
+    AsyncSaveError,
+    ElasticCheckpointManager,
+    MANIFEST_FORMAT,
+    MANIFEST_VERSION,
+    load_manifest,
+)
+from .preemption import PREEMPT_FAULT, PreemptionGuard
+from .verify import run_elastic_suite
+
+__all__ = [
+    "AsyncSaveError",
+    "ElasticCheckpointManager",
+    "MANIFEST_FORMAT",
+    "MANIFEST_VERSION",
+    "PREEMPT_FAULT",
+    "PreemptionGuard",
+    "chaos",
+    "load_manifest",
+    "run_elastic_suite",
+]

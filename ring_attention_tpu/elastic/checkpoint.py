@@ -1,0 +1,755 @@
+"""Sharded, async, manifest-committed checkpoints with elastic restore.
+
+PR 1's :class:`~ring_attention_tpu.utils.checkpoint.CheckpointManager`
+writes one monolithic ``arrays.npz`` per step from the main thread and
+can only resume at the identical device count.  At ring-attention scale
+that is three separate walls: the full state must fit one host buffer,
+the train loop stalls for the whole serialization, and a preempted job
+that comes back on a different slice shape cannot restart at all.  This
+manager removes all three:
+
+**Sharded layout.**  Each step directory holds one ``shard_dNNN.npz``
+per addressable-shard group (the device that owns the shard — replicated
+leaves are stored once, by their first holder), with every leaf entry
+stored as its raw bytes (dtype-agnostic: bf16 and any future ml_dtypes
+kind round-trip bit-exactly).  A SHA-256 per shard file is recorded in
+the manifest.
+
+**Manifest commit.**  The manifest (step, mesh descriptor, per-leaf
+shape/dtype/sharding spec, shard index table, shard digests) is the LAST
+file written into the pid-stamped staging directory, which is then
+``os.replace``d into place — one atomic rename commits the whole step.
+A death at ANY instant leaves either the previous checkpoint or the new
+one fully valid, never a torn mix: no committed step directory can lack
+its manifest, and a half-written staging dir (dead writer pid) is swept
+by the next save.  The chaos harness (:mod:`.chaos`) plants hard-death
+points at every window of this protocol, and ``tests/test_elastic.py``
+kills real processes at each of them.
+
+**Async, double-buffered saves.**  ``save()`` snapshots the state to
+host memory synchronously (the only part that must not race the next
+step's donated buffers) and does file I/O + hashing on a background
+thread; the train loop overlaps the write with the next steps and a
+background failure surfaces on the next ``save()``/``wait()`` instead of
+vanishing.
+
+**Elastic restore.**  ``restore()`` rebuilds each leaf at the CURRENT
+mesh with ``jax.make_array_from_callback``: every target shard is
+assembled on host by global-position gather/scatter from whichever old
+shard files overlap it, streaming one shard at a time — peak host memory
+is one target shard plus one old shard, never the global array.  The
+same code path serves same-mesh resume (target index == stored index,
+one copy) and re-mesh resume (4 -> 2 or 2 -> 4 devices); values are
+restored bit-exactly either way, so the loss trajectory continues within
+reduction-order noise (pinned in ``tests/test_elastic.py``).  Corrupt or
+truncated shard files fail the digest check and fall back (one warning)
+to the newest older intact step, exactly like PR 1's manager.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import warnings
+from typing import Any, Callable
+
+import jax
+
+from ..utils.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointStructureError,
+    _fsync_dir,
+    _sha256,
+)
+from ..utils.resilience import DirectoryLock, pid_alive
+from . import chaos
+
+_STEP_PREFIX = "step_"
+_MANIFEST = "manifest.json"
+MANIFEST_FORMAT = "elastic-ckpt"
+MANIFEST_VERSION = 1
+
+# manifest keys every reader requires; load_manifest rejects anything less
+_REQUIRED_KEYS = (
+    "format", "version", "step", "mesh", "treedef", "leaf_count",
+    "leaves", "files",
+)
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _np_dtype(name: str):
+    """Resolve a dtype name, including ml_dtypes kinds (bfloat16...)."""
+    np = _np()
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _spec_to_json(sharding) -> list | None:
+    """PartitionSpec of a NamedSharding as JSON (None for other kinds)."""
+    from jax.sharding import NamedSharding
+
+    if not isinstance(sharding, NamedSharding):
+        return None
+    out: list = []
+    for entry in tuple(sharding.spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(e) for e in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _norm_index(index, shape) -> list[list[int]]:
+    """A shard's index (tuple of slices) as [[start, stop], ...]."""
+    out = []
+    for slc, dim in zip(index, shape):
+        start, stop, step = slc.indices(dim)
+        if step != 1:
+            raise ValueError(f"strided shard index unsupported: {slc}")
+        out.append([int(start), int(stop)])
+    return out
+
+
+def load_manifest(path: str) -> dict:
+    """Read + schema-validate one ``manifest.json``; raises
+    :class:`CheckpointCorruptError` on unreadable/unknown manifests (the
+    restore path treats both as "that step never completed")."""
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(f"unreadable manifest {path} ({e})") from e
+    if manifest.get("format") != MANIFEST_FORMAT or manifest.get(
+        "version"
+    ) != MANIFEST_VERSION:
+        raise CheckpointCorruptError(
+            f"{path}: not an elastic checkpoint manifest "
+            f"(format={manifest.get('format')!r} "
+            f"version={manifest.get('version')!r}; this reader understands "
+            f"{MANIFEST_FORMAT}/{MANIFEST_VERSION})"
+        )
+    missing = [k for k in _REQUIRED_KEYS if k not in manifest]
+    if missing:
+        raise CheckpointCorruptError(
+            f"{path}: manifest missing required keys {missing}"
+        )
+    if len(manifest["leaves"]) != manifest["leaf_count"]:
+        raise CheckpointCorruptError(
+            f"{path}: leaf table length {len(manifest['leaves'])} != "
+            f"leaf_count {manifest['leaf_count']}"
+        )
+    return manifest
+
+
+class AsyncSaveError(RuntimeError):
+    """A background checkpoint write failed; carries the original error
+    and is raised on the NEXT ``save()``/``wait()`` so the failure cannot
+    silently cost every subsequent checkpoint too."""
+
+
+class ElasticCheckpointManager:
+    """Sharded async checkpoints in ``<directory>/step_<8 digits>/``.
+
+    See the module docstring for the commit protocol.  Like PR 1's
+    manager this targets single-process jobs (every device addressable);
+    the layout is multi-process-shaped (shard files are grouped by owner
+    and the manifest records a process count) so the pod-scale extension
+    is a new writer, not a new format.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        keep: int = 3,
+        async_save: bool = True,
+        lock_stale_age: float = 30.0,
+        lock_timeout: float = 600.0,
+    ) -> None:
+        if keep < 1:
+            raise ValueError(
+                f"ElasticCheckpointManager: keep must be >= 1, got {keep}"
+            )
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "ElasticCheckpointManager is single-process for now; "
+                "multi-host jobs keep using save_checkpoint (Orbax)"
+            )
+        self.directory = os.fspath(os.path.abspath(directory))
+        self.keep = keep
+        self.async_save = async_save
+        os.makedirs(self.directory, exist_ok=True)
+        self._dirlock = DirectoryLock(
+            self.directory, stale_age=lock_stale_age
+        )
+        # generous: a multi-GB shard write + hashing legitimately holds
+        # a competing manager's save out for minutes
+        self.lock_timeout = lock_timeout
+        self._inflight: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self.last_resume: dict | None = None
+        self.last_manifest: dict | None = None
+
+    # -- directory bookkeeping ----------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+
+    def all_steps(self) -> list[int]:
+        """Committed steps (manifest present), ascending."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if not name.startswith(_STEP_PREFIX) or "." in name:
+                continue
+            try:
+                step = int(name[len(_STEP_PREFIX):])
+            except ValueError:
+                continue
+            if os.path.isfile(
+                os.path.join(self.directory, name, _MANIFEST)
+            ):
+                steps.append(step)
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def latest_manifest(self) -> dict | None:
+        """The newest intact step's manifest (digests NOT verified —
+        this is the cheap pre-restore peek re-mesh planning needs), or
+        None.  Steps with unreadable manifests are skipped."""
+        for step in reversed(self.all_steps()):
+            try:
+                return load_manifest(
+                    os.path.join(self._step_dir(step), _MANIFEST)
+                )
+            except CheckpointCorruptError:
+                continue
+        return None
+
+    def _sweep(self) -> None:
+        """Delete dead writers' staging dirs and stale ``.old`` backups
+        (recovering a backup whose live step vanished).  Staging dirs are
+        pid-stamped; a live pid's dir belongs to a concurrent writer and
+        is left alone."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for name in names:
+            path = os.path.join(self.directory, name)
+            if ".writing-" in name:
+                try:
+                    writer = int(name.rsplit("-", 1)[-1])
+                except ValueError:
+                    # unparsable writer suffix: same safety rule as the
+                    # monolithic manager's sweep — only delete past a
+                    # minimum age (it might be a live writer from a
+                    # manager version with another naming scheme)
+                    try:
+                        age = time.time() - os.path.getmtime(path)
+                    except OSError:
+                        continue
+                    if age >= 60.0:
+                        shutil.rmtree(path, ignore_errors=True)
+                    continue
+                if writer != os.getpid() and pid_alive(writer):
+                    continue
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.endswith(".old"):
+                live = path[: -len(".old")]
+                if os.path.isfile(os.path.join(live, _MANIFEST)):
+                    shutil.rmtree(path, ignore_errors=True)
+                elif os.path.isfile(os.path.join(path, _MANIFEST)):
+                    shutil.rmtree(live, ignore_errors=True)
+                    try:
+                        os.replace(path, live)
+                    except OSError:
+                        pass
+
+    def _prune(self) -> None:
+        for step in self.all_steps()[: -self.keep]:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    # -- snapshot (synchronous half of an async save) -----------------
+
+    def _snapshot(self, state: Any) -> dict:
+        """Copy every leaf's unique shards to host memory.
+
+        This runs on the caller's thread BEFORE save returns: once it
+        completes, the background writer holds its own host buffers and
+        the train loop may donate/overwrite the device arrays freely —
+        the double-buffer boundary.
+        """
+        np = _np()
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        mesh = None
+        snap_leaves = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                sharding = leaf.sharding
+                from jax.sharding import NamedSharding
+
+                if isinstance(sharding, NamedSharding) and mesh is None:
+                    mesh = sharding.mesh
+                seen: dict[tuple, Any] = {}
+                for shard in leaf.addressable_shards:
+                    index = tuple(
+                        tuple(s.indices(d))
+                        for s, d in zip(shard.index, leaf.shape)
+                    )
+                    if index in seen:  # replicated copy: store once
+                        continue
+                    seen[index] = shard
+                shards = []
+                for shard in seen.values():
+                    arr = np.ascontiguousarray(np.asarray(shard.data))
+                    shards.append({
+                        "owner": int(getattr(shard.device, "id", 0)),
+                        "index": _norm_index(shard.index, leaf.shape),
+                        "bytes": np.frombuffer(arr.tobytes(), np.uint8),
+                    })
+                snap_leaves.append({
+                    "shape": [int(d) for d in leaf.shape],
+                    "dtype": str(leaf.dtype),
+                    "spec": _spec_to_json(sharding),
+                    "shards": shards,
+                })
+            else:
+                arr = np.ascontiguousarray(np.asarray(leaf))
+                snap_leaves.append({
+                    "shape": [int(d) for d in arr.shape],
+                    "dtype": str(arr.dtype),
+                    "spec": None,
+                    "shards": [{
+                        "owner": 0,
+                        "index": [[0, int(d)] for d in arr.shape],
+                        "bytes": np.frombuffer(arr.tobytes(), np.uint8),
+                    }],
+                })
+        from ..parallel.mesh import mesh_descriptor
+
+        return {
+            "treedef": str(treedef),
+            "leaves": snap_leaves,
+            "mesh": mesh_descriptor(mesh),
+            "devices": int(jax.device_count()),
+        }
+
+    # -- write (background half) --------------------------------------
+
+    def _write(self, step: int, snap: dict) -> str:
+        np = _np()
+        with self._dirlock.locked(timeout=self.lock_timeout):
+            self._sweep()
+            final = self._step_dir(step)
+            stage = f"{final}.writing-{os.getpid()}"
+            shutil.rmtree(stage, ignore_errors=True)
+            os.makedirs(stage)
+            try:
+                # group shard payloads by owner device -> one file per
+                # addressable-shard group
+                groups: dict[int, dict[str, Any]] = {}
+                leaf_table = []
+                for i, leaf in enumerate(snap["leaves"]):
+                    entries = []
+                    for j, shard in enumerate(leaf["shards"]):
+                        fname = f"shard_d{shard['owner']:03d}.npz"
+                        key = f"L{i:05d}_{j:03d}"
+                        groups.setdefault(fname, {})[key] = shard["bytes"]
+                        entries.append({
+                            "file": fname,
+                            "key": key,
+                            "index": shard["index"],
+                        })
+                    leaf_table.append({
+                        "shape": leaf["shape"],
+                        "dtype": leaf["dtype"],
+                        "spec": leaf["spec"],
+                        "shards": entries,
+                    })
+                files = {}
+                for fname in sorted(groups):
+                    path = os.path.join(stage, fname)
+                    with open(path, "wb") as f:
+                        np.savez(f, **groups[fname])
+                        f.flush()
+                        os.fsync(f.fileno())
+                    files[fname] = {
+                        "sha256": _sha256(path),
+                        "bytes": os.path.getsize(path),
+                    }
+                    # chaos: die with SOME shard files durable and the
+                    # manifest absent — the torn-write window the commit
+                    # protocol must make unobservable
+                    chaos.chaos_point(chaos.KILL_MID_SHARD)
+                manifest = {
+                    "format": MANIFEST_FORMAT,
+                    "version": MANIFEST_VERSION,
+                    "step": int(step),
+                    "mesh": snap["mesh"],
+                    "devices": snap["devices"],
+                    "process_count": int(jax.process_count()),
+                    "treedef": snap["treedef"],
+                    "leaf_count": len(leaf_table),
+                    "leaves": leaf_table,
+                    "files": files,
+                }
+                man_path = os.path.join(stage, _MANIFEST)
+                with open(man_path, "w") as f:
+                    json.dump(manifest, f, indent=1)
+                    f.flush()
+                    os.fsync(f.fileno())
+                _fsync_dir(stage)
+                # chaos: die with a COMPLETE staging dir, commit rename
+                # not yet executed — next boot must resume the previous
+                # step and sweep this one
+                chaos.chaos_point(chaos.KILL_PRE_COMMIT)
+                backup = None
+                if os.path.isdir(final):
+                    backup = final + ".old"
+                    shutil.rmtree(backup, ignore_errors=True)
+                    os.replace(final, backup)
+                os.replace(stage, final)  # THE commit: one atomic rename
+                _fsync_dir(self.directory)
+                # chaos: die right after the commit — next boot must see
+                # THIS step as valid, with only .old debris to sweep
+                chaos.chaos_point(chaos.KILL_POST_COMMIT)
+                if backup is not None:
+                    shutil.rmtree(backup, ignore_errors=True)
+            except BaseException:
+                shutil.rmtree(stage, ignore_errors=True)
+                raise
+            self._prune()
+            return final
+
+    def _write_guarded(self, step: int, snap: dict) -> None:
+        try:
+            self._write(step, snap)
+        except BaseException as e:  # noqa: BLE001 — re-raised on next save/wait
+            self._error = e
+
+    # -- public save/wait ---------------------------------------------
+
+    def wait(self) -> None:
+        """Block until the in-flight background save (if any) finishes;
+        re-raise its failure as :class:`AsyncSaveError`."""
+        t, self._inflight = self._inflight, None
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise AsyncSaveError(
+                f"background checkpoint save failed: "
+                f"{type(err).__name__}: {err}"
+            ) from err
+
+    def save(self, step: int, state: Any, *, block: bool | None = None) -> None:
+        """Checkpoint ``state`` as step ``step``.
+
+        Blocks only for the host snapshot (and for a still-running
+        PREVIOUS save — the write depth is one, double-buffered); the
+        file I/O, hashing, and commit run on a background thread unless
+        ``block=True`` (or the manager was built ``async_save=False``).
+        """
+        self.wait()
+        snap = self._snapshot(state)
+        sync = (not self.async_save) if block is None else block
+        if sync:
+            self._write(step, snap)
+            return
+        t = threading.Thread(
+            target=self._write_guarded, args=(step, snap),
+            name=f"elastic-ckpt-save-{step}", daemon=True,
+        )
+        self._inflight = t
+        t.start()
+
+    def close(self) -> None:
+        """Flush the in-flight save (call at clean shutdown / drain)."""
+        self.wait()
+
+    # -- restore -------------------------------------------------------
+
+    def _target_sharding(self, ref, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(ref, jax.Array):
+            if isinstance(ref.sharding, NamedSharding):
+                return ref.sharding
+            if mesh is not None:
+                return NamedSharding(mesh, PartitionSpec())
+            return ref.sharding
+        if mesh is not None:
+            return NamedSharding(mesh, PartitionSpec())
+        return None
+
+    def _load_step(self, step: int, template: Any, mesh) -> Any:
+        np = _np()
+        path = self._step_dir(step)
+        manifest = load_manifest(os.path.join(path, _MANIFEST))
+        if manifest["step"] != step:
+            raise CheckpointCorruptError(
+                f"step {step}: manifest records step {manifest['step']}"
+            )
+        for fname, meta in manifest["files"].items():
+            fpath = os.path.join(path, fname)
+            try:
+                digest = _sha256(fpath)
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f"step {step}: unreadable shard file {fname} ({e})"
+                ) from e
+            if digest != meta.get("sha256"):
+                raise CheckpointCorruptError(
+                    f"step {step}: shard file {fname} checksum mismatch "
+                    f"(truncated or corrupted write)"
+                )
+
+        t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
+        if manifest["treedef"] != str(t_treedef) or manifest[
+            "leaf_count"
+        ] != len(t_leaves):
+            raise CheckpointStructureError(
+                f"step {step}: saved state structure does not match the "
+                f"restore template (did the model or optimizer definition "
+                f"change?).\n  saved:    {manifest['leaf_count']} leaves, "
+                f"{manifest['treedef']}\n  template: {len(t_leaves)} "
+                f"leaves, {t_treedef}"
+            )
+
+        handles: dict[str, Any] = {}
+
+        def entry(fname: str, key: str):
+            if fname not in handles:
+                handles[fname] = np.load(os.path.join(path, fname))
+            try:
+                return handles[fname][key]
+            except KeyError as e:
+                raise CheckpointCorruptError(
+                    f"step {step}: shard entry {key} missing from {fname}"
+                ) from e
+
+        def assemble(leaf_i: int, leaf_man: dict, target: list[list[int]]):
+            """Gather/scatter ONE target shard from the old shard files
+            overlapping it — streaming, one old shard at a time."""
+            dtype = _np_dtype(leaf_man["dtype"])
+            tshape = tuple(hi - lo for lo, hi in target)
+            buf = np.empty(tshape, dtype)
+            covered = 0
+            for shard in leaf_man["shards"]:
+                old = shard["index"]
+                inter = [
+                    (max(tl, ol), min(th, oh))
+                    for (tl, th), (ol, oh) in zip(target, old)
+                ]
+                if any(lo >= hi for lo, hi in inter):
+                    continue
+                raw = entry(shard["file"], shard["key"])
+                oshape = tuple(hi - lo for lo, hi in old)
+                arr = np.ndarray(oshape, dtype, buffer=raw.tobytes())
+                src = tuple(
+                    slice(lo - ol, hi - ol)
+                    for (lo, hi), (ol, _) in zip(inter, old)
+                )
+                dst = tuple(
+                    slice(lo - tl, hi - tl)
+                    for (lo, hi), (tl, _) in zip(inter, target)
+                )
+                buf[dst] = arr[src]
+                vol = 1
+                for lo, hi in inter:
+                    vol *= hi - lo
+                covered += vol
+            want = 1
+            for d in tshape:
+                want *= d
+            if covered != want:
+                raise CheckpointCorruptError(
+                    f"step {step}: leaf {leaf_i} region {target} only "
+                    f"{covered}/{want} elements covered by stored shards"
+                )
+            return buf
+
+        try:
+            out = []
+            for i, (ref, leaf_man) in enumerate(
+                zip(t_leaves, manifest["leaves"])
+            ):
+                # chaos: die mid-resume — the checkpoint is read-only
+                # here, so a killed resume must leave it fully intact
+                chaos.chaos_point(chaos.KILL_MID_RESUME)
+                shape = tuple(leaf_man["shape"])
+                if isinstance(ref, jax.Array) and shape != tuple(ref.shape):
+                    raise CheckpointStructureError(
+                        f"step {step}: leaf {i} shape {shape} != "
+                        f"template {tuple(ref.shape)}"
+                    )
+                full = [[0, int(d)] for d in shape]
+                if not isinstance(ref, jax.Array):
+                    arr = assemble(i, leaf_man, full)
+                    out.append(arr if arr.shape else arr[()])
+                    continue
+                dtype = _np_dtype(leaf_man["dtype"])
+                sharding = self._target_sharding(ref, mesh)
+                want_dtype = ref.dtype
+
+                def cb(index, _i=i, _man=leaf_man, _shape=shape,
+                       _dtype=dtype, _want=want_dtype):
+                    target = _norm_index(index, _shape)
+                    buf = assemble(_i, _man, target)
+                    if _dtype != _want:
+                        buf = buf.astype(_want)
+                    return buf
+
+                if sharding is None or (
+                    not getattr(ref, "_committed", True) and mesh is None
+                ):
+                    import jax.numpy as jnp
+
+                    arr = assemble(i, leaf_man, full)
+                    if dtype != want_dtype:
+                        arr = arr.astype(want_dtype)
+                    out.append(jnp.asarray(arr))
+                else:
+                    out.append(jax.make_array_from_callback(
+                        shape, sharding, cb
+                    ))
+        finally:
+            for h in handles.values():
+                h.close()
+        return jax.tree_util.tree_unflatten(t_treedef, out), manifest
+
+    def restore(
+        self, template: Any, *, mesh=None, step: int | None = None
+    ) -> tuple[Any, int] | None:
+        """Restore the newest intact checkpoint (or exactly ``step``) at
+        the CURRENT mesh/template shardings — re-meshing from whatever
+        factoring the checkpoint was written under.
+
+        Returns ``(state, step)`` or None (nothing intact on disk);
+        corrupt steps warn once each and fall back; structure mismatches
+        raise (fallback would hit the same mismatch).  ``mesh`` supplies
+        the placement for template leaves without an explicit
+        ``NamedSharding`` (restored replicated over it).
+        """
+        from ..utils.resilience import LockTimeout
+
+        # held for the whole read: the sweep recovers .old debris even
+        # when the dead writer died holding the lock, and a concurrent
+        # manager's prune cannot delete a step mid-digest-read; a stuck
+        # lock degrades to the unlocked read with one warning
+        try:
+            with self._dirlock.locked(timeout=self.lock_timeout):
+                self._sweep()
+                return self._restore_unlocked(template, mesh, step)
+        except LockTimeout:
+            warnings.warn(
+                f"ElasticCheckpointManager: directory lock "
+                f"{self._dirlock.path} stuck; restoring WITHOUT the lock "
+                f"(a concurrent prune could race this read)",
+                stacklevel=2,
+            )
+            return self._restore_unlocked(template, mesh, step)
+
+    def _restore_unlocked(
+        self, template: Any, mesh, step: int | None
+    ) -> tuple[Any, int] | None:
+        if step is not None and not os.path.isdir(self._step_dir(step)):
+            raise FileNotFoundError(
+                f"ElasticCheckpointManager: no checkpoint for step {step} "
+                f"in {self.directory} (existing steps: {self.all_steps()})"
+            )
+        candidates = [step] if step is not None else list(
+            reversed(self.all_steps())
+        )
+        for s in candidates:
+            try:
+                state, manifest = self._load_step(s, template, mesh)
+            except CheckpointCorruptError as e:
+                if step is not None:
+                    # an EXPLICITLY requested corrupt step raises: a
+                    # None return reads as "cold start" and would
+                    # silently reinitialize over the named history
+                    raise
+                warnings.warn(
+                    f"ElasticCheckpointManager: skipping corrupt "
+                    f"checkpoint ({e}); falling back to the previous step",
+                    stacklevel=2,
+                )
+                continue
+            self.last_manifest = manifest
+            return state, s
+        return None
+
+    def resume_or_init(
+        self,
+        init_fn: Callable[[], Any],
+        *,
+        mesh=None,
+        seq_len: int | None = None,
+    ) -> tuple[Any, int]:
+        """The one-call elastic resume: build fresh state, restore over
+        it if anything intact is on disk, and report what happened in
+        ``self.last_resume`` (step, old/new mesh descriptors, re-mesh
+        flag, one-line diagnostics — the resume banner callers print).
+
+        ``seq_len`` (when given) is revalidated against the current
+        mesh's sequence world — a re-mesh that breaks divisibility fails
+        HERE with a one-line diagnostic, not 40 layers deep in a
+        padding mismatch.
+        """
+        from ..parallel.mesh import mesh_descriptor, validate_seq_len
+
+        if seq_len is not None:
+            validate_seq_len(seq_len, mesh)
+        state = init_fn()
+        restored = self.restore(state, mesh=mesh)
+        if restored is None:
+            self.last_resume = None
+            return state, 0
+        state, step = restored
+        old_mesh = self.last_manifest.get("mesh")
+        new_mesh = mesh_descriptor(mesh)
+        remeshed = old_mesh != new_mesh
+        diags = []
+        if remeshed:
+            def _fmt(d):
+                if not d:
+                    return "unmeshed"
+                return "x".join(
+                    f"{a}={s}" for a, s in zip(d["axes"], d["shape"])
+                )
+
+            diags.append(
+                f"re-mesh resume: checkpoint step {step} written at "
+                f"{_fmt(old_mesh)}, restored at {_fmt(new_mesh)} "
+                f"(resharded shard-streaming load; values bit-exact)"
+            )
+        self.last_resume = {
+            "step": step,
+            "old_mesh": old_mesh,
+            "new_mesh": new_mesh,
+            "remeshed": remeshed,
+            "diagnostics": diags,
+        }
+        return state, step + 1

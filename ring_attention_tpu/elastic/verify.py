@@ -1,0 +1,271 @@
+"""Machine-checked contracts for the elastic checkpoint layer.
+
+Run via ``tools/check_contracts.py --elastic`` (and the analysis
+self-run): CPU-only, virtual devices, no hardware.  Four checks, each
+returning one-line violations like the memory/coverage suites:
+
+- **manifest round-trip** — a saved step's manifest re-reads through
+  :func:`~.checkpoint.load_manifest` schema-validated, JSON round-trips
+  byte-stably, records the mesh descriptor and per-leaf dtype/spec, and
+  its shard digests match the files on disk.
+- **resharded == direct** — a state saved on a ring-4 mesh and restored
+  on a ring-2 mesh is bit-identical (values AND dtypes) to the same
+  state saved and restored natively on the ring-2 mesh — the re-mesh
+  gather/scatter adds or loses nothing.
+- **corrupt shard falls back** — truncating one shard file of the newest
+  step makes restore fall back (one warning) to the previous intact
+  step, never return torn data.
+- **commit protocol debris** — a dead writer's staging directory is
+  invisible to ``all_steps`` and swept by the next save; a live writer's
+  is left alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from . import chaos
+from .checkpoint import ElasticCheckpointManager, load_manifest
+
+
+def _mesh(n: int):
+    from ..parallel.mesh import create_mesh
+
+    return create_mesh(ring_size=n, devices=jax.devices()[:n])
+
+
+def _state(mesh) -> dict:
+    """A small but representative pytree: a seq-sharded f32, a
+    seq-sharded bf16 (the raw-bytes dtype path), a replicated matrix,
+    and a scalar step counter."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import seq_partition
+
+    rng = np.random.default_rng(7)
+    seq = NamedSharding(mesh, P(None, seq_partition(mesh)))
+    rep = NamedSharding(mesh, P())
+    return {
+        "acts": jax.device_put(
+            jnp.asarray(rng.normal(size=(2, 32, 3)), jnp.float32),
+            NamedSharding(mesh, P(None, seq_partition(mesh), None)),
+        ),
+        "kv": jax.device_put(
+            jnp.asarray(rng.normal(size=(4, 16)), jnp.bfloat16), seq
+        ),
+        "w": jax.device_put(
+            jnp.asarray(rng.normal(size=(8, 8)), jnp.float32), rep
+        ),
+        "count": jax.device_put(jnp.asarray(11, jnp.int32), rep),
+    }
+
+
+def _values(state) -> list:
+    return [jax.device_get(x) for x in jax.tree_util.tree_leaves(state)]
+
+
+def _bit_equal(a, b) -> bool:
+    import numpy as np
+
+    return a.dtype == b.dtype and a.shape == b.shape and bool(
+        np.array_equal(
+            np.ascontiguousarray(a).reshape(-1).view(np.uint8),
+            np.ascontiguousarray(b).reshape(-1).view(np.uint8),
+        )
+    )
+
+
+def check_manifest_roundtrip() -> list[str]:
+    violations: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        mesh = _mesh(4)
+        state = _state(mesh)
+        mgr = ElasticCheckpointManager(tmp, async_save=False)
+        mgr.save(3, state)
+        man_path = os.path.join(mgr._step_dir(3), "manifest.json")
+        try:
+            manifest = load_manifest(man_path)
+        except Exception as e:  # noqa: BLE001 — a violation, not a crash
+            return [f"manifest failed to load: {e}"]
+        # JSON round-trip stability: what we re-serialize is what's there
+        rt = json.loads(json.dumps(manifest))
+        if rt != manifest:
+            violations.append("manifest does not JSON round-trip stably")
+        if manifest["step"] != 3:
+            violations.append(f"manifest step {manifest['step']} != 3")
+        md = manifest["mesh"]
+        if not md or "seq" not in md["axes"]:
+            violations.append(f"manifest mesh descriptor wrong: {md}")
+        dtypes = {leaf["dtype"] for leaf in manifest["leaves"]}
+        if "bfloat16" not in dtypes:
+            violations.append(
+                f"bf16 leaf dtype not recorded (saw {sorted(dtypes)})"
+            )
+        specs = [leaf["spec"] for leaf in manifest["leaves"]]
+        if not any(s and "seq" in str(s) for s in specs):
+            violations.append(
+                f"no per-leaf sharding spec records the seq axis: {specs}"
+            )
+        sharded = [leaf for leaf in manifest["leaves"]
+                   if len(leaf["shards"]) > 1]
+        if not sharded:
+            violations.append(
+                "no leaf stored as multiple shards on a 4-way mesh"
+            )
+        from ..utils.checkpoint import _sha256
+
+        for fname, meta in manifest["files"].items():
+            digest = _sha256(os.path.join(mgr._step_dir(3), fname))
+            if digest != meta["sha256"]:
+                violations.append(
+                    f"manifest digest for {fname} does not match disk"
+                )
+    return violations
+
+
+def check_reshard_equals_direct() -> list[str]:
+    violations: list[str] = []
+    with tempfile.TemporaryDirectory() as big, \
+            tempfile.TemporaryDirectory() as small:
+        mesh4, mesh2 = _mesh(4), _mesh(2)
+        state4 = _state(mesh4)
+        state2 = _state(mesh2)  # same values, natively on the small mesh
+        ElasticCheckpointManager(big, async_save=False).save(1, state4)
+        ElasticCheckpointManager(small, async_save=False).save(1, state2)
+
+        template = _state(mesh2)
+        resharded = ElasticCheckpointManager(big).restore(
+            template, mesh=mesh2
+        )
+        direct = ElasticCheckpointManager(small).restore(
+            template, mesh=mesh2
+        )
+        if resharded is None or direct is None:
+            return ["restore returned None for an intact checkpoint"]
+        for i, (a, b, orig) in enumerate(zip(
+            _values(resharded[0]), _values(direct[0]), _values(state4)
+        )):
+            if not _bit_equal(a, b):
+                violations.append(
+                    f"leaf {i}: resharded (4->2) load != direct load at "
+                    f"the new mesh (dtype {a.dtype} vs {b.dtype})"
+                )
+            if not _bit_equal(a, orig):
+                violations.append(
+                    f"leaf {i}: resharded load != original values"
+                )
+        # and the restored leaves actually live on the NEW mesh
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            resharded[0]
+        )[0]:
+            from jax.sharding import NamedSharding
+
+            if isinstance(leaf, jax.Array) and isinstance(
+                leaf.sharding, NamedSharding
+            ):
+                if dict(leaf.sharding.mesh.shape).get("seq") not in (None, 2):
+                    violations.append(
+                        f"{path}: restored onto mesh "
+                        f"{dict(leaf.sharding.mesh.shape)}, want seq=2"
+                    )
+    return violations
+
+
+def check_corrupt_shard_falls_back() -> list[str]:
+    violations: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        mesh = _mesh(4)
+        mgr = ElasticCheckpointManager(tmp, async_save=False)
+        good = _state(mesh)
+        mgr.save(1, good)
+        mgr.save(2, _state(mesh))
+        step2 = mgr._step_dir(2)
+        shard = sorted(
+            n for n in os.listdir(step2) if n.startswith("shard_")
+        )[0]
+        chaos.corrupt_file(os.path.join(step2, shard), "truncate")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            restored = mgr.restore(_state(mesh), mesh=mesh)
+        if restored is None:
+            return ["corrupt newest step: restore found nothing at all"]
+        if restored[1] != 1:
+            violations.append(
+                f"corrupt newest step: restored step {restored[1]}, "
+                f"want fallback to 1"
+            )
+        if not any("corrupt" in str(w.message) for w in caught):
+            violations.append("fallback happened without its warning")
+        for i, (a, b) in enumerate(zip(
+            _values(restored[0]), _values(good)
+        )):
+            if not _bit_equal(a, b):
+                violations.append(f"fallback leaf {i} != step-1 values")
+    return violations
+
+
+def check_commit_debris() -> list[str]:
+    violations: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        mesh = _mesh(2)
+        mgr = ElasticCheckpointManager(tmp, async_save=False)
+        mgr.save(1, _state(mesh))
+        dead = os.path.join(tmp, "step_00000005.writing-999999999")
+        os.makedirs(dead)
+        live = os.path.join(tmp, f"step_00000006.writing-{os.getpid()}")
+        os.makedirs(live)
+        if mgr.all_steps() != [1]:
+            violations.append(
+                f"staging dirs leaked into all_steps: {mgr.all_steps()}"
+            )
+        mgr.save(2, _state(mesh))  # save sweeps first
+        if os.path.isdir(dead):
+            violations.append("dead writer's staging dir survived sweep")
+        # our own pid counts as "this process's leftover" and is swept;
+        # only a DIFFERENT live pid must survive — fake one with the
+        # parent pid (alive, not us)
+        ppid = os.getppid()
+        other = os.path.join(tmp, f"step_00000007.writing-{ppid}")
+        os.makedirs(other, exist_ok=True)
+        mgr.save(3, _state(mesh))
+        if ppid > 0 and not os.path.isdir(other):
+            violations.append(
+                "live concurrent writer's staging dir was deleted"
+            )
+        if mgr.all_steps() != [1, 2, 3]:
+            violations.append(f"steps after sweeps: {mgr.all_steps()}")
+    return violations
+
+
+def run_elastic_suite() -> list[tuple[str, list[str]]]:
+    """Every elastic contract as ``(name, violations)`` rows (the
+    check_contracts CLI table shape)."""
+    return [
+        ("elastic/manifest_roundtrip", check_manifest_roundtrip()),
+        ("elastic/reshard_equals_direct", check_reshard_equals_direct()),
+        ("elastic/corrupt_shard_fallback", check_corrupt_shard_falls_back()),
+        ("elastic/commit_debris_sweep", check_commit_debris()),
+    ]
+
+
+def _main() -> int:
+    checks = run_elastic_suite()
+    bad = 0
+    for name, violations in checks:
+        status = "ok  " if not violations else "FAIL"
+        print(f"{status} {name}")  # ra: allow(RA006 suite CLI output)
+        for v in violations:
+            print(f"     {v}")  # ra: allow(RA006 suite CLI output)
+        bad += bool(violations)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

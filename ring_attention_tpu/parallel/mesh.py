@@ -201,6 +201,104 @@ def seq_partition(mesh: Mesh):
     return axes[0] if len(axes) == 1 else axes
 
 
+def mesh_descriptor(mesh: Mesh | None) -> dict | None:
+    """JSON-able identity of a mesh: axis names + sizes, in axis order.
+
+    This is what the elastic checkpoint manifest records
+    (``elastic/checkpoint.py``): enough to decide on restore whether the
+    job came back at the same factoring or needs a re-mesh, without
+    serializing device objects (which don't survive a restart anyway).
+    """
+    if mesh is None:
+        return None
+    return {
+        "axes": [str(a) for a in mesh.axis_names],
+        "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+    }
+
+
+def remesh_plan(
+    old: dict | None, n_devices: int
+) -> tuple[dict, list[str]]:
+    """Plan a mesh factoring for ``n_devices`` given a checkpoint's old
+    :func:`mesh_descriptor` — the elastic-resume re-mesh rule.
+
+    Preference order (each preserved factor keeps resume semantics
+    closest to the old run): keep ``data`` and ``ulysses`` exactly when
+    they still divide the new world, and absorb ALL growth/shrink into
+    the ``ring``/``seq`` axis (sequence shards are what the resharded
+    loader re-scatters anyway); when a preserved factor no longer
+    divides, fall back to its gcd with the world.  Returns
+    ``(create_mesh_kwargs, diagnostics)`` where every decision that
+    changed something is one human-readable line — the resume banner.
+    """
+    from math import gcd
+
+    if n_devices < 1:
+        raise ValueError(f"remesh_plan: n_devices must be >= 1, got {n_devices}")
+    diags: list[str] = []
+    if not old:
+        diags.append(
+            f"re-mesh: no mesh recorded in the checkpoint; defaulting to "
+            f"one ring of {n_devices}"
+        )
+        return {"ring_size": n_devices}, diags
+    sizes = dict(zip(old.get("axes", []), old.get("shape", [])))
+    old_world = 1
+    for s in sizes.values():
+        old_world *= int(s)
+    data = int(sizes.get(DATA_AXIS, 1))
+    ulysses = int(sizes.get(ULYSSES_AXIS, 1))
+    ring = int(sizes.get(RING_AXIS, sizes.get(SEQ_AXIS, 1)))
+    if old_world != n_devices:
+        diags.append(f"re-mesh: world {old_world} -> {n_devices}")
+    if n_devices % data != 0:
+        new_data = gcd(data, n_devices)
+        diags.append(
+            f"re-mesh: data {data} does not divide world {n_devices}; "
+            f"shrinking to gcd {new_data}"
+        )
+        data = new_data
+    rest = n_devices // data
+    if rest % ulysses != 0:
+        new_u = gcd(ulysses, rest)
+        diags.append(
+            f"re-mesh: ulysses {ulysses} does not divide {rest}; "
+            f"shrinking to gcd {new_u}"
+        )
+        ulysses = new_u
+    new_ring = rest // ulysses
+    if new_ring != ring:
+        diags.append(f"re-mesh: ring {ring} -> {new_ring}")
+    kwargs: dict = {"ring_size": new_ring, "data_size": data}
+    if ulysses > 1:
+        kwargs["ulysses_size"] = ulysses
+    return kwargs, diags
+
+
+def validate_seq_len(seq_len: int, mesh: Mesh | None) -> None:
+    """One-line divisibility diagnostic for the resume path.
+
+    ``auto_shard`` pads a non-divisible sequence, but a RESUMED run whose
+    padding changed under it silently shifts bucket boundaries against
+    the checkpointed positions — so elastic resume requires exact
+    divisibility and says exactly what to change when it fails.
+    """
+    if mesh is None:
+        return
+    world = seq_world(mesh)
+    if seq_len % world != 0:
+        axes = "x".join(
+            f"{a}={mesh.shape[a]}" for a in seq_axes(mesh)
+        )
+        raise ValueError(
+            f"seq_len {seq_len} % sequence world {world} ({axes}) != 0 — "
+            f"resume at this device count needs seq_len divisible by "
+            f"{world}; pad the sequence or pick a ring size that divides "
+            f"{seq_len}"
+        )
+
+
 def initialize_multihost(**kwargs) -> None:
     """Join a multi-host (multi-process) TPU job before building meshes.
 

@@ -24,10 +24,13 @@ import hashlib
 import json
 import os
 import shutil
+import time
 import warnings
 from typing import Any, Callable
 
 import jax
+
+from .resilience import DirectoryLock, pid_alive
 
 
 def _checkpointer():
@@ -149,12 +152,34 @@ class CheckpointManager:
     rather than writing per-host files that look like full checkpoints.
     """
 
-    def __init__(self, directory: str | os.PathLike, *, keep: int = 3):
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        keep: int = 3,
+        lock_stale_age: float = 30.0,
+        lock_timeout: float = 600.0,
+    ):
         if keep < 1:
             raise ValueError(f"CheckpointManager: keep must be >= 1, got {keep}")
         self.directory = os.fspath(os.path.abspath(directory))
         self.keep = keep
+        # how long a save waits on a competing manager's critical
+        # section before failing loudly: generous by default — a
+        # multi-GB serialize+fsync+prune can legitimately hold the lock
+        # for minutes, and converting that into a crash would be worse
+        # than the race the lock fixes
+        self.lock_timeout = lock_timeout
         os.makedirs(self.directory, exist_ok=True)
+        # two managers on one directory (a restarted job racing its own
+        # not-yet-dead predecessor, or a sweep racing a save) serialize
+        # their save/prune/sweep sections through the watcher-protocol
+        # lock: atomic mkdir acquisition, pid-stamped, takeover only when
+        # the holder pid is dead AND the lock is at least lock_stale_age
+        # seconds old (utils/resilience.DirectoryLock)
+        self._dirlock = DirectoryLock(
+            self.directory, stale_age=lock_stale_age
+        )
 
     # -- directory bookkeeping ---------------------------------------
 
@@ -180,11 +205,24 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    # a temp dir with no parsable pid suffix must still be this old
+    # before the sweep deletes it (it might be a live writer from a
+    # manager version with another naming scheme)
+    _TMP_MIN_AGE_S = 60.0
+
     def _sweep_tmp(self) -> None:
-        """Clean up after a preempted save: delete half-written temp dirs,
-        and RECOVER a ``step_*.old`` backup whose live step vanished (the
-        crash landed between rename-aside and rename-into-place — the
-        backup is a complete, verified checkpoint)."""
+        """Clean up after a preempted save: delete half-written temp dirs
+        whose WRITER IS DEAD, and RECOVER a ``step_*.old`` backup whose
+        live step vanished (the crash landed between rename-aside and
+        rename-into-place — the backup is a complete, verified
+        checkpoint).
+
+        Temp dirs are pid-stamped (``.tmp-step_NNNNNNNN-<pid>``); a dir
+        whose pid is still alive belongs to a CONCURRENT writer mid-save
+        and is left alone — the pre-fix sweep deleted it, so two managers
+        on one directory could destroy each other's in-flight saves.  A
+        dir with no parsable pid is deleted only past a minimum age.
+        """
         try:
             names = os.listdir(self.directory)
         except FileNotFoundError:
@@ -192,6 +230,19 @@ class CheckpointManager:
         for name in names:
             path = os.path.join(self.directory, name)
             if name.startswith(".tmp-"):
+                try:
+                    writer = int(name.rsplit("-", 1)[-1])
+                except ValueError:
+                    writer = None
+                if writer is not None and writer != os.getpid() and pid_alive(writer):
+                    continue  # live concurrent writer: not ours to sweep
+                if writer is None:
+                    try:
+                        age = time.time() - os.stat(path).st_mtime
+                    except OSError:
+                        continue
+                    if age < self._TMP_MIN_AGE_S:
+                        continue
                 shutil.rmtree(path, ignore_errors=True)
             elif name.endswith(".old"):
                 live = path[: -len(".old")]
@@ -220,8 +271,15 @@ class CheckpointManager:
                 "CheckpointManager is single-process; use save_checkpoint "
                 "(Orbax) for multi-host jobs"
             )
-        self._sweep_tmp()
         leaves, treedef = _state_leaves(state)
+        with self._dirlock.locked(timeout=self.lock_timeout):
+            return self._save_locked(step, leaves, treedef, np)
+
+    def _save_locked(self, step: int, leaves, treedef, np) -> str:
+        # under the directory lock: sweep, write, rename, prune are one
+        # critical section, so a concurrent manager's prune can never
+        # interleave with this save's rename window
+        self._sweep_tmp()
         final = self._step_dir(step)
         tmp = os.path.join(
             self.directory, f".tmp-{_STEP_PREFIX}{step:08d}-{os.getpid()}"
@@ -362,7 +420,31 @@ class CheckpointManager:
         steps share the saved structure, so fallback would mask a real
         code/checkpoint incompatibility.
         """
-        self._sweep_tmp()  # recover an orphaned .old backup before listing
+        from .resilience import LockTimeout
+
+        # restore holds the directory lock: (a) the pre-listing sweep
+        # recovers an orphaned .old backup even when the dead writer
+        # died HOLDING the lock (blocking acquire takes a stale lock
+        # over once pid-dead + stale_age), and (b) a concurrent
+        # manager's prune can no longer delete a step mid-digest-read.
+        # On a genuinely stuck lock, degrade to the unlocked read (one
+        # warning): restore is read-only and availability wins.
+        try:
+            with self._dirlock.locked(timeout=self.lock_timeout):
+                self._sweep_tmp()
+                return self._restore_unlocked(template, step)
+        except LockTimeout:
+            warnings.warn(
+                f"CheckpointManager: directory lock {self._dirlock.path} "
+                f"stuck; restoring WITHOUT the lock (a concurrent prune "
+                f"could race this read)",
+                stacklevel=2,
+            )
+            return self._restore_unlocked(template, step)
+
+    def _restore_unlocked(
+        self, template: Any, step: int | None
+    ) -> tuple[Any, int] | None:
         if step is not None and not os.path.isdir(self._step_dir(step)):
             # absent is not corrupt: an explicitly-requested step that was
             # never written (or already pruned) must not warn "corrupt"
@@ -378,6 +460,12 @@ class CheckpointManager:
             try:
                 return self._load_step(s, template), s
             except CheckpointCorruptError as e:
+                if step is not None:
+                    # an EXPLICITLY requested step that is corrupt must
+                    # raise, not warn-and-return-None: callers treat
+                    # None as "cold start", which would silently
+                    # reinitialize over the history the operator named
+                    raise
                 warnings.warn(
                     f"CheckpointManager: skipping corrupt checkpoint "
                     f"({e}); falling back to the previous step",

@@ -29,6 +29,8 @@ at trace/dispatch time.
 
 from __future__ import annotations
 
+import os
+import shutil
 import threading
 import time
 import warnings
@@ -253,6 +255,182 @@ def with_retries(
         f"(last: {type(last).__name__}: {last})",
         last,
     )
+
+
+# ----------------------------------------------------------------------
+# Cross-process directory lock (watcher protocol, in-library form)
+# ----------------------------------------------------------------------
+
+
+def pid_alive(pid: int) -> bool:
+    """Best-effort liveness check for a local pid (signal 0 probe).
+
+    ``EPERM`` counts as alive (the process exists, we just can't signal
+    it); any other failure counts as dead.  This is the takeover predicate
+    of the TPU window watcher's lock protocol (tools/tpu_window_watch.sh),
+    shared here so checkpoint managers apply the same rule.
+    """
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+class LockTimeout(TimeoutError):
+    """A :class:`DirectoryLock` could not be acquired within its budget."""
+
+
+class DirectoryLock:
+    """Atomic cross-process lock on a directory, with stale takeover.
+
+    The watcher shell protocol (PR 1, ``tools/tpu_window_watch.sh``),
+    ported to library code: acquisition is ``os.mkdir`` of a lock
+    directory (atomic-exclusive on every POSIX filesystem) followed by a
+    pid stamp inside it, so a held lock always names its holder.  A
+    SIGKILLed holder (no cleanup ran) must not block the directory
+    forever: a contender may take over only when the pid file exists,
+    the pid is **dead**, AND the lock is at least ``stale_age`` seconds
+    old — and the takeover renames the stale lock aside first, so of N
+    concurrent contenders exactly one wins the rename and the losers
+    retry cleanly (a plain ``rmtree`` could delete the winner's freshly
+    acquired lock).
+
+    Used by ``utils/checkpoint.py`` and ``elastic/checkpoint.py`` so two
+    managers on one directory serialize their save/prune/sweep sections
+    instead of interleaving (one manager's retention pass deleting the
+    step another just renamed into place).
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        name: str = ".ckpt.lock",
+        *,
+        stale_age: float = 30.0,
+        poll: float = 0.05,
+    ) -> None:
+        self.path = os.path.join(os.fspath(directory), name)
+        self.stale_age = stale_age
+        self.poll = poll
+        self._held = False
+        # within-process serialization: the filesystem lock is per
+        # PROCESS (one pid stamp), so two threads of one process — the
+        # async checkpoint writer and a concurrent restore's sweep —
+        # must contend here first; without this, thread B would see
+        # _held, "acquire" a lock thread A holds, and release it out
+        # from under A's critical section
+        self._tlock = threading.Lock()
+
+    def _try_acquire(self) -> bool:
+        try:
+            os.mkdir(self.path)
+        except FileExistsError:
+            return False
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            return self._try_acquire()
+        with open(os.path.join(self.path, "pid"), "w") as f:
+            f.write(str(os.getpid()))
+        self._held = True
+        return True
+
+    def _takeover_if_stale(self) -> None:
+        try:
+            with open(os.path.join(self.path, "pid")) as f:
+                holder = int(f.read().strip())
+        except (OSError, ValueError):
+            # no/garbled pid stamp: a holder that died between mkdir and
+            # the stamp write (SIGKILL, ENOSPC).  The age rule below is
+            # the only takeover predicate left — a healthy acquirer
+            # stamps within milliseconds, so an unstamped lock past
+            # stale_age is debris, not a writer
+            holder = None
+        try:
+            age = time.time() - os.stat(self.path).st_mtime
+        except OSError:
+            return  # lock vanished between checks: next acquire retries
+        if (holder is not None and pid_alive(holder)) or age < self.stale_age:
+            return
+        aside = f"{self.path}.stale-{os.getpid()}"
+        try:
+            os.rename(self.path, aside)  # one winner among N contenders
+        except OSError:
+            return  # another contender won the rename; retry acquire
+        shutil.rmtree(aside, ignore_errors=True)
+
+    def acquire(self, timeout: float | None = 60.0) -> bool:
+        """Acquire, blocking up to ``timeout`` seconds (None = forever;
+        0 = one nonblocking attempt).  Returns True when held; raises
+        :class:`LockTimeout` when the budget runs out.  NOT re-entrant:
+        a thread that already holds the lock must not re-acquire it."""
+        # ONE deadline covers both waits: the in-process tlock and the
+        # filesystem loop share the budget (counting it twice would let
+        # acquire(600) block for 20 minutes)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # within-process contention first: a sibling thread holding the
+        # filesystem lock is contention, not ownership
+        if timeout == 0:
+            if not self._tlock.acquire(blocking=False):
+                return False
+        elif timeout is None:
+            self._tlock.acquire()
+        else:
+            if not self._tlock.acquire(timeout=timeout):
+                raise LockTimeout(
+                    f"DirectoryLock: {self.path} held by another thread "
+                    f"of this process after {timeout:.1f}s"
+                )
+        try:
+            first = True
+            while True:
+                if self._try_acquire():
+                    return True
+                self._takeover_if_stale()
+                if deadline is not None and time.monotonic() >= deadline:
+                    # nonblocking mode still deserves one retry AFTER the
+                    # takeover: a stale lock (dead holder) must not make
+                    # a timeout=0 acquire fail when the dir is free now
+                    if first and self._try_acquire():
+                        return True
+                    if timeout == 0:
+                        self._tlock.release()
+                        return False
+                    raise LockTimeout(
+                        f"DirectoryLock: {self.path} still held after "
+                        f"{timeout:.1f}s (holder pid in {self.path}/pid)"
+                    )
+                first = False
+                time.sleep(self.poll)
+        except BaseException:
+            if not self._held:
+                self._tlock.release()
+            raise
+
+    def release(self) -> None:
+        if not self._held:
+            return
+        self._held = False
+        shutil.rmtree(self.path, ignore_errors=True)
+        self._tlock.release()
+
+    @contextmanager
+    def locked(self, timeout: float | None = 60.0) -> Iterator[bool]:
+        """``with lock.locked():`` — acquire/release around a block.
+        With ``timeout=0`` the block still runs when the lock is busy,
+        and the yielded bool says whether it is actually held (callers
+        use this for optional housekeeping: skip the sweep, never block
+        a restore on another process's save)."""
+        got = self.acquire(timeout)
+        try:
+            yield got
+        finally:
+            if got:
+                self.release()
 
 
 # ----------------------------------------------------------------------

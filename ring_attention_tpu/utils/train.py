@@ -60,6 +60,7 @@ def make_train_step(
     collect_metrics: bool = False,
     offload_opt_state: bool = False,
     offload_mesh: Mesh | None = None,
+    on_step_end: Callable[..., None] | None = None,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
@@ -123,6 +124,23 @@ def make_train_step(
       metric derives from values the step already computes, so
       instrumentation adds no collectives to the compiled program
       (pinned by ``tests/test_telemetry.py``).
+    - ``on_step_end`` — a HOST callback ``on_step_end(outputs)`` invoked
+      after every step call with the step's full output tuple.  This is
+      the hook the elastic runtime hangs off (``elastic/``): the async
+      checkpointer snapshots state from it and ``PreemptionGuard`` checks
+      its drain flag — neither belongs inside the compiled program.  The
+      callback runs OUTSIDE the jitted step, after dispatch: the output
+      arrays are handed over un-fetched, so a callback that only inspects
+      Python state adds no device sync (one that reads values forces the
+      step to finish, same as any host read).  Unset, this is a strict
+      no-op: the returned step is the exact same callable, not a wrapper.
+      When set, the wrapper exposes the undecorated step as
+      ``step.__wrapped__`` — ``tests/test_elastic.py`` pins that its
+      compiled program carries the identical collective sequence to the
+      hookless step (the hook adds zero collectives by construction).
+      Do NOT wrap the hooked step in an outer ``jax.jit`` (the hook
+      would be traced away); the wrapper detects tracing and raises —
+      jit ``step.__wrapped__`` or pass ``jit_donate=True`` instead.
     """
     if accum_steps < 1:
         raise ValueError(f"make_train_step: accum_steps must be >= 1, got {accum_steps}")
@@ -200,11 +218,35 @@ def make_train_step(
         return compat.host_device_put(opt_state, offload_mesh)
 
     def finish(step):
-        if not jit_donate:
-            return step
-        from . import compat
+        if jit_donate:
+            from . import compat
 
-        return compat.jit(step, donate_argnums=(0, 1))
+            step = compat.jit(step, donate_argnums=(0, 1))
+        if on_step_end is None:
+            return step  # strict no-op: the very same callable
+        import functools
+
+        @functools.wraps(step)
+        def stepped(*args, **kwargs):
+            out = step(*args, **kwargs)
+            # a host hook baked into a trace would fire ONCE at compile
+            # time and never again — the drain check / async snapshot it
+            # exists for would silently stop running.  Fail loudly
+            # instead of being traced away.
+            if any(isinstance(x, jax.core.Tracer)
+                   for x in jax.tree_util.tree_leaves(out)):
+                raise RuntimeError(
+                    "make_train_step(on_step_end=...): the hooked step "
+                    "was traced by an outer jax.jit, which would "
+                    "silently drop the host hook. jit the inner step "
+                    "instead (step.__wrapped__), or build with "
+                    "jit_donate=True so make_train_step jits it for you."
+                )
+            on_step_end(out)
+            return out
+
+        stepped.__wrapped__ = step  # the lowerable inner step (HLO pin)
+        return stepped
 
     if not skip_nonfinite and not collect_metrics:
 

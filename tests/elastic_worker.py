@@ -1,0 +1,154 @@
+"""Victim process for the elastic chaos matrix (tests/test_elastic.py).
+
+One tiny ring-attention training run on virtual CPU devices, wired
+exactly the way a production job would be: elastic sharded checkpoints
+(async saves, manifest commit), re-mesh resume planned from the latest
+manifest, and a PreemptionGuard drain.  The parent kills it anywhere —
+chaos faults arrive via ``RING_ATTN_CHAOS`` (armed at startup), the
+device count via ``RING_ATTN_CHAOS_DEVICES`` — restarts it at any
+device count, and audits the per-step loss log this worker appends
+(one fsync'd JSON line per completed step, so a hard death can never
+lose or tear the evidence).
+
+    python tests/elastic_worker.py --ckpt-dir D --loss-log L [--steps 10]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--loss-log", required=True)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--save-every", type=int, default=3)
+    ap.add_argument("--sync-save", action="store_true",
+                    help="synchronous saves (the chaos kill points then "
+                         "fire on the main thread, deterministically "
+                         "ordered against the loss log)")
+    args = ap.parse_args()
+
+    n_dev = int(os.environ.get("RING_ATTN_CHAOS_DEVICES", "4"))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_dev}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+    # share the test suite's persistent compile cache: repeat chaos runs
+    # pay XLA compilation once per (device count, shape), not per run
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+    import optax
+
+    from ring_attention_tpu.elastic import (
+        ElasticCheckpointManager,
+        PreemptionGuard,
+        chaos,
+    )
+    from ring_attention_tpu.models import RingTransformer
+    from ring_attention_tpu.parallel import (
+        create_mesh,
+        remesh_plan,
+        shard_batch,
+    )
+    from ring_attention_tpu.utils import make_train_step
+
+    armed = chaos.arm_from_env()
+    if armed:
+        print(f"chaos armed: {armed}", flush=True)
+
+    mgr = ElasticCheckpointManager(
+        args.ckpt_dir, keep=3, async_save=not args.sync_save
+    )
+    manifest = mgr.latest_manifest()
+    if manifest is not None:
+        plan, diags = remesh_plan(manifest.get("mesh"), n_dev)
+        for line in diags:
+            print(line, flush=True)
+    else:
+        plan = {"ring_size": n_dev}
+    mesh = create_mesh(**plan)
+    ring = plan["ring_size"] * (plan.get("ulysses_size") or 1)
+
+    model = RingTransformer(
+        num_tokens=64, dim=16, depth=1, heads=2, dim_head=8, causal=True,
+        striped=True, bucket_size=args.seq_len // ring, mesh=mesh,
+        use_ring=True,
+    )
+    # the SAME synthetic batch every step and every run: loss
+    # trajectories are then comparable across kills and device counts
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 64, (2, args.seq_len // 2))
+    tokens = shard_batch(
+        np.concatenate([base, base], axis=1).astype(np.int32), mesh
+    )
+    opt = optax.adamw(1e-2)
+
+    def fresh():
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        return {"params": params, "opt_state": opt.init(params)}
+
+    state, start = mgr.resume_or_init(
+        fresh, mesh=mesh, seq_len=args.seq_len
+    )
+    if mgr.last_resume is not None:
+        for line in mgr.last_resume["diagnostics"]:
+            print(line, flush=True)
+
+    def loss_fn(p, t):
+        return model.apply(p, t, return_loss=True)
+
+    step_fn = jax.jit(make_train_step(loss_fn, opt))
+
+    log = open(args.loss_log, "a")
+
+    def log_row(step: int, loss: float) -> None:
+        log.write(json.dumps(
+            {"step": step, "loss": loss, "world": n_dev}
+        ) + "\n")
+        log.flush()
+        os.fsync(log.fileno())
+
+    params, opt_state = state["params"], state["opt_state"]
+    with PreemptionGuard() as guard:
+        for step in range(start, args.steps):
+            params, opt_state, loss = step_fn(params, opt_state, tokens)
+            loss = float(loss)  # sync: the step is genuinely finished
+            # mid-run hard death (kill_at_step=K): after the step
+            # computed, before anything was saved or logged
+            chaos.chaos_point(chaos.KILL_AT_STEP, step=step)
+            log_row(step, loss)
+            if guard.should_stop():
+                mgr.save(
+                    step,
+                    {"params": params, "opt_state": opt_state},
+                    block=True,
+                )
+                print(f"DRAINED {guard.signal_name} step={step}",
+                      flush=True)
+                break
+            if step % args.save_every == 0 or step == args.steps - 1:
+                mgr.save(step, {"params": params, "opt_state": opt_state})
+    mgr.close()
+    log.close()
+    print(f"ELASTIC-OK start={start} world={n_dev}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -603,6 +603,47 @@ def test_lint_ra010_grid_seam_bypass():
     assert "reason is mandatory" in v.message
 
 
+def test_lint_ra011_signal_outside_elastic():
+    """RA011: signal handlers / process-kill primitives outside the
+    elastic runtime or utils/resilience.py flag (an ad-hoc handler
+    silently replaces PreemptionGuard's drain); the owning modules and
+    a reasoned allow are clean."""
+    bad = (
+        "import os, signal\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGTERM, lambda *_: None)\n"
+        "def die(pid):\n"
+        "    os.kill(pid, 9)\n"
+        "    os._exit(1)\n"
+    )
+    violations = lint_source(bad, "ring_attention_tpu/utils/train.py")
+    assert [v.rule for v in violations] == ["RA011"] * 3
+    assert "PreemptionGuard" in violations[0].message
+    # the owners of preemption semantics are exempt
+    for home in ("ring_attention_tpu/elastic/preemption.py",
+                 "ring_attention_tpu/elastic/chaos.py",
+                 "ring_attention_tpu/utils/resilience.py"):
+        assert lint_source(bad, home) == [], home
+    allowed = bad.replace(
+        "os.kill(pid, 9)",
+        "os.kill(pid, 0)  # ra: allow(RA011 liveness probe, signal 0)",
+    ).replace(
+        "signal.signal(signal.SIGTERM, lambda *_: None)",
+        "signal.signal(signal.SIGTERM, h)  "
+        "# ra: allow(RA011 restoring a saved handler)",
+    ).replace(
+        "os._exit(1)",
+        "os._exit(1)  # ra: allow(RA011 post-fork child must not atexit)",
+    )
+    assert lint_source(allowed, "ring_attention_tpu/utils/train.py") == []
+    bare = bad.replace(
+        "os.kill(pid, 9)", "os.kill(pid, 9)  # ra: allow(RA011)"
+    )
+    assert any("reason is mandatory" in v.message for v in lint_source(
+        bare, "ring_attention_tpu/utils/train.py"
+    ))
+
+
 # ----------------------------------------------------------------------
 # Self-runs: the package itself is clean
 # ----------------------------------------------------------------------

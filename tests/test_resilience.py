@@ -590,3 +590,192 @@ def test_checkpoint_explicit_missing_step_is_not_found_not_corrupt(tmp_path):
     mgr.save(1, _make_state())
     with pytest.raises(FileNotFoundError, match="step 42"):
         mgr.restore(_make_state(), step=42)
+
+
+# ----------------------------------------------------------------------
+# Cross-process manager races (PR 12 satellite): pid-aware sweep + the
+# watcher-protocol directory lock around save/prune
+# ----------------------------------------------------------------------
+
+
+def test_sweep_spares_live_concurrent_writers_tmp_dir(tmp_path):
+    """The pre-fix _sweep_tmp deleted ANY .tmp-* dir — including a
+    concurrent manager's live in-flight save.  Now only dead writers'
+    debris is swept: a temp dir stamped with a LIVE pid (another
+    process's save in progress) survives, a dead pid's is removed."""
+    mgr = CheckpointManager(tmp_path, keep=3)
+    mgr.save(0, _make_state())
+    live_pid = os.getppid()  # alive and not us: a concurrent writer
+    live = os.path.join(str(tmp_path), f".tmp-step_00000099-{live_pid}")
+    dead = os.path.join(str(tmp_path), ".tmp-step_00000098-999999999")
+    os.makedirs(live)
+    os.makedirs(dead)
+    mgr.save(1, _make_state(1.0))  # save sweeps first
+    assert os.path.isdir(live), "live concurrent writer's temp dir deleted"
+    assert not os.path.isdir(dead), "dead writer's temp dir survived"
+    # unparsable writer pid: only swept past the minimum age
+    odd = os.path.join(str(tmp_path), ".tmp-whatever")
+    os.makedirs(odd)
+    mgr.save(2, _make_state(2.0))
+    assert os.path.isdir(odd), "young unparsable temp dir swept too eagerly"
+
+
+def test_keep_vs_concurrent_save_never_loses_the_latest(tmp_path):
+    """Two managers (keep=2) hammering ONE directory from threads — the
+    interleaving that used to let one manager's retention prune race
+    another's rename window.  Under the directory lock every save+prune
+    is a critical section: afterwards exactly the newest steps remain,
+    every surviving step restores intact, and no .tmp debris is left."""
+    import threading
+
+    errors: list[BaseException] = []
+
+    def writer(offset: int) -> None:
+        try:
+            mgr = CheckpointManager(tmp_path, keep=2, lock_stale_age=5.0)
+            for i in range(4):
+                mgr.save(offset + 2 * i, _make_state(float(offset + i)))
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(off,))
+               for off in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    mgr = CheckpointManager(tmp_path, keep=2)
+    steps = mgr.all_steps()
+    assert len(steps) <= 2 and max(steps) == 7, steps
+    restored = mgr.restore(_make_state())
+    assert restored is not None and restored[1] == max(steps)
+    assert not glob.glob(os.path.join(str(tmp_path), ".tmp-*"))
+
+
+def test_directory_lock_stale_takeover_and_contention(tmp_path):
+    """The watcher protocol, in library form: takeover requires pid file
+    + dead pid + minimum age; a LIVE holder is never stolen from."""
+    from ring_attention_tpu.utils.resilience import (
+        DirectoryLock,
+        LockTimeout,
+    )
+
+    # stale lock (dead pid, old): a contender takes over
+    lock_dir = os.path.join(str(tmp_path), ".ckpt.lock")
+    os.makedirs(lock_dir)
+    with open(os.path.join(lock_dir, "pid"), "w") as f:
+        f.write("999999999")
+    old = time.time() - 60
+    os.utime(lock_dir, (old, old))
+    lock = DirectoryLock(str(tmp_path), stale_age=1.0)
+    assert lock.acquire(timeout=5.0)
+    lock.release()
+
+    # live holder: a second contender times out instead of stealing
+    holder = DirectoryLock(str(tmp_path), stale_age=30.0)
+    assert holder.acquire(timeout=1.0)
+    try:
+        thief = DirectoryLock(str(tmp_path), stale_age=30.0)
+        with pytest.raises(LockTimeout):
+            thief.acquire(timeout=0.3)
+        assert thief.acquire(timeout=0) is False  # nonblocking miss
+    finally:
+        holder.release()
+    # released: immediately acquirable again
+    assert DirectoryLock(str(tmp_path)).acquire(timeout=1.0)
+
+
+def test_directory_lock_not_shared_across_threads(tmp_path):
+    """A sibling thread holding the SAME DirectoryLock instance is
+    contention, not ownership: the async checkpoint writer must never
+    have its lock 'acquired' and released out from under it by a
+    concurrent restore on the main thread."""
+    import threading
+
+    from ring_attention_tpu.utils.resilience import DirectoryLock
+
+    lock = DirectoryLock(str(tmp_path))
+    entered = threading.Event()
+    done = threading.Event()
+
+    def writer():
+        with lock.locked():
+            entered.set()
+            done.wait(timeout=30)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        assert entered.wait(timeout=10)
+        with lock.locked(timeout=0) as held:
+            assert held is False  # busy, not re-entrant ownership
+        assert os.path.isdir(lock.path), (
+            "the writer's lock dir was released by another thread"
+        )
+    finally:
+        done.set()
+        t.join()
+    # after the writer released, nonblocking acquire succeeds
+    with lock.locked(timeout=0) as held:
+        assert held is True
+
+
+def test_directory_lock_pidless_debris_taken_over_by_age(tmp_path):
+    """A holder killed between mkdir and the pid stamp leaves a pid-less
+    lock dir; past stale_age that is debris, not a writer — it must not
+    block the directory forever."""
+    from ring_attention_tpu.utils.resilience import DirectoryLock
+
+    lock_dir = os.path.join(str(tmp_path), ".ckpt.lock")
+    os.makedirs(lock_dir)  # no pid file inside
+    old = time.time() - 60
+    os.utime(lock_dir, (old, old))
+    lock = DirectoryLock(str(tmp_path), stale_age=1.0)
+    assert lock.acquire(timeout=5.0)
+    lock.release()
+
+
+def test_restore_recovers_old_backup_despite_crashed_lock_holder(tmp_path):
+    """The worst crash window: the writer died between rename-aside and
+    rename-into-place WHILE HOLDING the directory lock.  Restore must
+    still take the stale lock over (pid-dead + stale_age), run the
+    sweep, recover the .old backup — never cold-start over it."""
+    mgr = CheckpointManager(tmp_path, keep=3, lock_stale_age=0.5)
+    mgr.save(7, _make_state(3.0))
+    live = os.path.join(str(tmp_path), "step_00000007")
+    os.replace(live, live + ".old")  # crash state: only the backup left
+    lock_dir = os.path.join(str(tmp_path), ".ckpt.lock")
+    os.makedirs(lock_dir)  # ...and the dead writer still "holds" the lock
+    with open(os.path.join(lock_dir, "pid"), "w") as f:
+        f.write("999999999")
+    old = time.time() - 60
+    os.utime(lock_dir, (old, old))
+    restored = CheckpointManager(tmp_path, lock_stale_age=0.5).restore(
+        _make_state()
+    )
+    assert restored is not None and restored[1] == 7
+    np.testing.assert_array_equal(
+        np.asarray(restored[0]["params"]["w"]),
+        np.asarray(_make_state(3.0)["params"]["w"]),
+    )
+
+
+def test_checkpoint_explicit_corrupt_step_raises_not_cold_start(tmp_path):
+    """restore(step=N) on a corrupt step must raise, not warn-and-return
+    None: None reads as 'cold start' and would silently reinitialize
+    over the history the operator explicitly named."""
+    from ring_attention_tpu.utils.checkpoint import CheckpointCorruptError
+
+    mgr = CheckpointManager(tmp_path, keep=3)
+    mgr.save(1, _make_state(1.0))
+    mgr.save(2, _make_state(2.0))
+    npz = os.path.join(str(tmp_path), "step_00000002", "arrays.npz")
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(_make_state(), step=2)
+    # without step=, the documented fallback still works
+    with pytest.warns(UserWarning, match="corrupt"):
+        restored = mgr.restore(_make_state())
+    assert restored is not None and restored[1] == 1

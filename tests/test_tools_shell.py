@@ -10,6 +10,7 @@ protocol (temp-dir + rename acquisition; pid-dead + min-age staleness).
 """
 
 import glob
+import json
 import os
 import py_compile
 import re
@@ -191,7 +192,7 @@ def test_check_contracts_flags_parse():
     )
     assert proc.returncode == 0, proc.stderr
     for flag in ("--strategy", "--mesh", "--json", "--devices", "--memory",
-                 "--coverage", "--dataflow"):
+                 "--coverage", "--dataflow", "--elastic"):
         assert flag in proc.stdout, f"{flag} missing from --help"
 
 
@@ -206,6 +207,26 @@ def test_check_contracts_coverage_exits_zero():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "coverage rows sound and tight" in proc.stdout
+
+
+def test_check_contracts_elastic_exits_zero():
+    """Acceptance: ``check_contracts.py --elastic`` holds the elastic
+    checkpoint contracts (manifest schema round-trip, resharded-load ==
+    direct-load at a changed mesh, corrupt-shard fallback, commit-debris
+    sweep) on CPU virtual devices and exits 0."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--elastic"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "4/4 elastic checks hold" in proc.stdout
+    as_json = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--elastic", "--json"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert as_json.returncode == 0, as_json.stdout + as_json.stderr
+    payload = json.loads(as_json.stdout)
+    assert payload["ok"] is True and payload["checked"] == 4
 
 
 def test_check_contracts_mask_filter():

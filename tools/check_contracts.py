@@ -26,6 +26,12 @@ accumulator carry — both flash paths, the int8 hop chain, the counter
 bwd pack) and the SPMD divergence checker (branch-invariant collective
 sequences for every strategy, on simulated devices).
 
+``--elastic`` runs the elastic checkpoint contracts
+(``elastic/verify.py``): manifest schema round-trip (mesh descriptor,
+per-leaf dtype/spec, shard digests matching disk), resharded-load ==
+direct-load at a changed mesh (bit-exact), corrupt-shard fallback, and
+commit-protocol debris sweeping — all on CPU virtual devices.
+
 Examples:
   python tools/check_contracts.py --strategy all
   python tools/check_contracts.py --strategy hybrid --mesh 1x2x4
@@ -33,6 +39,7 @@ Examples:
   python tools/check_contracts.py --memory
   python tools/check_contracts.py --coverage
   python tools/check_contracts.py --dataflow
+  python tools/check_contracts.py --elastic
 
 Exit status 0 = every contract holds.  Runs anywhere (no TPU needed):
 ``--devices N`` simulated host devices, default 8.
@@ -109,6 +116,13 @@ def main(argv: list[str] | None = None) -> int:
                         help="run the jaxpr dataflow passes (precision-"
                              "flow audit + SPMD divergence checker) "
                              "instead of the collective contracts")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run the elastic checkpoint contracts "
+                             "(manifest schema round-trip, resharded-"
+                             "load == direct-load at a changed mesh, "
+                             "corrupt-shard fallback, commit-debris "
+                             "sweep) instead of the collective "
+                             "contracts")
     args = parser.parse_args(argv)
 
     # must precede the first jax import
@@ -182,6 +196,29 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{len(reports) - len(failed)}/{len(reports)} coverage "
                   f"rows sound and tight")
         return 1 if failed else 0
+
+    if args.elastic:
+        from ring_attention_tpu.elastic.verify import run_elastic_suite
+
+        checks = run_elastic_suite()
+        failed_names = [name for name, v in checks if v]
+        if args.json:
+            print(json.dumps({
+                "ok": not failed_names,
+                "checked": len(checks),
+                "checks": [
+                    {"name": name, "ok": not v, "violations": v}
+                    for name, v in checks
+                ],
+            }, indent=2))
+        else:
+            for name, v in checks:
+                print(f"{'ok  ' if not v else 'FAIL'} {name}")
+                for line in v:
+                    print(f"     {line}")
+            print(f"{len(checks) - len(failed_names)}/{len(checks)} "
+                  f"elastic checks hold")
+        return 1 if failed_names else 0
 
     if args.dataflow:
         from ring_attention_tpu.analysis.dataflow import (
