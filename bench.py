@@ -400,6 +400,9 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
         _counter_worker(seq_len, int(extra.get("ring", 4)),
                         extra.get("hop_compression"))
         return
+    if mode == "q8":
+        _q8_worker(seq_len, int(extra.get("ring", 4)))
+        return
     if mode == "decode":
         _decode_worker(impl, seq_len, extra)
         return
@@ -763,6 +766,116 @@ def _counter_worker(seq_len: int, ring: int, hop_compression: str | None) -> Non
                 "hop_overlap_fraction": comms["hop_overlap_fraction"],
                 "tokens_per_sec": round(seq_len / secs),
                 "impl": "pallas-counter",
+                "device": getattr(dev, "device_kind", str(dev)),
+                "ms_per_step": round(secs * 1e3, 2),
+                "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
+def _q8_worker(seq_len: int, ring: int) -> None:
+    """Single-chip simulation of the int8 COMPUTE hop chain (PR 13).
+
+    Where ``_counter_worker`` times the compressed ring's per-hop
+    dequant feeding bf16 kernels, this worker times what the dequant-free
+    composition actually executes: the KV payload quantized ONCE at ring
+    entry with kernel-ready scales (``quant.pack_kv(v_block=...)``), each
+    hop's span kernel consuming the int8 values + scales DIRECTLY
+    (``compute_dtype="int8"`` / ``kv_quantized=``) with q re-quantized
+    per hop and the f32 ``(acc, m, l)`` carry resumed in-kernel.  On
+    v5e/v5p the int8 MXU rate is ~2x bf16 peak, so ``vs_baseline`` /
+    ``mfu`` are reported against the BF16 peak (a number > the bf16 MFU
+    ceiling is the int8 win, not an accounting error).  Operand/
+    accumulator byte accounting and the wire terms ride along from
+    ``telemetry.ring_comms_accounting(compute_dtype="int8")``; phase 0's
+    collective fingerprint pins the ``counter_q8`` hop counts from
+    compiled HLO even on wedged-TPU rounds.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops import quant
+    from ring_attention_tpu.ops.pallas_flash import (
+        pallas_flash_fused,
+        pallas_flash_partials,
+    )
+    from ring_attention_tpu.utils.telemetry import ring_comms_accounting
+
+    dev, peak = _device_peak()
+    n_local = seq_len // ring
+    blk = 1024
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, HEADS, n_local, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    scale = DIM_HEAD**-0.5
+
+    def hop_sequence(q):
+        payload = quant.pack_kv(k, v, v_block=blk)  # once at ring entry
+
+        def hop_feed(i):
+            j = ring - 1 - i
+            return quant.payload_kernel_feed(
+                payload[:, :, :, j * n_local:(j + 1) * n_local], blk
+            )
+
+        carry = pallas_flash_partials(
+            q, None, None, scale=scale, causal_offset=0,
+            block_q=blk, block_k=blk,
+            compute_dtype="int8", kv_quantized=hop_feed(0),
+        )
+        for i in range(1, ring - 1):
+            carry = pallas_flash_partials(
+                q, None, None, scale=scale, block_q=blk, block_k=blk,
+                carry=carry, compute_dtype="int8", kv_quantized=hop_feed(i),
+            )
+        out, _ = pallas_flash_fused(
+            q, None, None, scale=scale, block_q=blk, block_k=blk,
+            carry=carry, compute_dtype="int8",
+            kv_quantized=hop_feed(ring - 1),
+        )
+        return out
+
+    iters = 3
+
+    @jax.jit
+    def chained(q):
+        def body(carry, _):
+            o = hop_sequence(carry)
+            return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+
+        out, ys = jax.lax.scan(body, q, None, length=iters)
+        return ys.astype(jnp.float32).sum()
+
+    compile_s, secs = _timed(chained, (q,), iters)
+    flops = (
+        FWD_MATMULS * 2 * HEADS * DIM_HEAD * n_local * n_local * (ring - 0.5)
+    )
+    tflops = flops / secs / 1e12
+    comms = ring_comms_accounting(
+        ring_size=ring, seq_len=seq_len, kv_heads=HEADS, heads=HEADS,
+        dim_head=DIM_HEAD, dtype_bytes=2, counter_rotate=True,
+        hop_compression="int8", compute_dtype="int8", peak_tflops=peak,
+    )
+    print(
+        json.dumps(
+            {
+                "value": round(tflops, 4),
+                "vs_baseline": round(tflops / peak, 4),
+                "mfu": round(tflops / peak, 4),
+                "seq_len": seq_len,
+                "ring": ring,
+                "compute_dtype": "int8",
+                "hop_compression": "int8",
+                "hop_bytes": comms["hop_bytes"],
+                "matmul_operand_bytes": comms["matmul_operand_bytes"],
+                "accumulator_bytes": comms["accumulator_bytes"],
+                "fwd_collectives": comms["fwd_collectives"],
+                "bwd_collectives": comms["bwd_collectives"],
+                "hop_overlap_fraction": comms["hop_overlap_fraction"],
+                "tokens_per_sec": round(seq_len / secs),
+                "impl": "pallas-q8",
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),
@@ -1639,6 +1752,41 @@ def main() -> None:
                     payload["value"] / result["ring_hops_tflops"], 4
                 )
             log.append(f"counter:pallas@{TARGET_SEQ}[int8]: ok")
+        else:
+            log.append(err)
+
+    # phase 4e — fwd262k_q8: the int8 COMPUTE hop chain (PR 13) at the
+    # same ring degree — quantized QK^T/PV kernels fed directly from the
+    # once-quantized hop payload (no per-hop dequant), f32 accumulators
+    # resumed in-kernel.  ROADMAP item 3's acceptance number: on silicon
+    # this should beat the fused bf16 fwd (int8 MXU ~2x peak); operand/
+    # accumulator byte accounting rides the JSON, the counter_q8 HLO
+    # fingerprint (phase 0) and the ring8_262k_q8 comms row are the
+    # wedge-honest CPU signals.
+    if got_target and budget_left(900):
+        payload, err = _run_attempt(
+            "pallas", TARGET_SEQ, "q8",
+            min(900, deadline - time.monotonic()),
+            {"ring": 4},
+        )
+        if payload is not None:
+            result["fwd262k_q8"] = payload["value"]
+            result["fwd262k_q8_tokens_per_sec"] = payload["tokens_per_sec"]
+            result["fwd262k_q8_ms"] = payload["ms_per_step"]
+            result["fwd262k_q8_hop_bytes"] = payload["hop_bytes"]
+            result["fwd262k_q8_operand_bytes"] = (
+                payload["matmul_operand_bytes"]
+            )
+            result["fwd262k_q8_accumulator_bytes"] = (
+                payload["accumulator_bytes"]
+            )
+            if result.get("ring_hops_tflops"):
+                # the int8-vs-bf16 matmul-feed speedup on the same device
+                # and hop schedule (>1 = the MXU rate win materialized)
+                result["fwd262k_q8_vs_ring_hops"] = round(
+                    payload["value"] / result["ring_hops_tflops"], 4
+                )
+            log.append(f"q8:pallas@{TARGET_SEQ}[int8-compute]: ok")
         else:
             log.append(err)
 

@@ -81,6 +81,12 @@ def main() -> None:
                     help="ship forward KV ring hops int8-quantized (per-"
                          "token absmax values + bitcast f32 scales in one "
                          "payload); accumulators and grads stay exact-dtype")
+    ap.add_argument("--compute-dtype", choices=["int8"], default=None,
+                    help="run the forward's QK^T/PV matmuls on int8 "
+                         "operands (pallas kernels; ~2x MXU rate on "
+                         "v5e/v5p); backward stays bf16 from exact "
+                         "residuals; composes with --hop-compression int8 "
+                         "into the dequant-free ring (docs/precision.md)")
     ap.add_argument("--pack", action="store_true",
                     help="packed-sequence training: concatenate variable-"
                          "length documents per row with segment ids — "
@@ -238,6 +244,7 @@ def main() -> None:
         ring_bidirectional=args.bidirectional,
         ring_counter_rotate=args.counter_rotate,
         ring_hop_compression=args.hop_compression,
+        compute_dtype=args.compute_dtype,
         remat=args.remat or args.remat_policy is not None,
         remat_policy=args.remat_policy,
         ff_chunk_size=args.ff_chunk_size,
@@ -385,6 +392,7 @@ def main() -> None:
                 dtype_bytes=2 if args.bf16 else 4, batch=args.batch,
                 depth=args.depth, counter_rotate=args.counter_rotate,
                 hop_compression=args.hop_compression,
+                compute_dtype=args.compute_dtype,
             )
         else:
             comms = {"ring_hops": 0, "ring_hops_per_step": 0, "hop_bytes": 0}
@@ -421,6 +429,7 @@ def main() -> None:
                 "ulysses": ulysses, "ring": ring,
                 "counter_rotate": args.counter_rotate,
                 "hop_compression": args.hop_compression,
+                "compute_dtype": args.compute_dtype,
                 "remat_policy": args.remat_policy,
                 "ff_chunk_size": args.ff_chunk_size,
                 "skip_nonfinite": guarded,

@@ -153,6 +153,31 @@ CONTRACTS: dict[str, dict[str, Any]] = {
             },
         },
     },
+    "counter_q8": {
+        "description": "counter-rotation with int8 hops feeding the int8 "
+                       "COMPUTE kernels directly (PR 13, dequant-free "
+                       "composition, docs/precision.md): the collective "
+                       "schedule is IDENTICAL to counter_compressed — the "
+                       "quantized matmuls change what the kernels read, "
+                       "never what the ring moves — and the payload still "
+                       "circulates as one int8 array per hop",
+        "impl": "pallas",
+        "mesh": "plain",
+        "ring_kwargs": {"counter_rotate": True, "hop_compression": "int8",
+                        "compute_dtype": "int8"},
+        "both_directions": True,
+        "axes": {"collective-permute": "seq"},
+        "hlo": {
+            "fwd": {"collective-permute": "ring"},
+            "fwdbwd": {"collective-permute": "2 * ring"},
+        },
+        "hop_bytes": {
+            "fwd": {
+                "min": "2 * b * kv_heads * chunk * (dim_head + 4)",
+                "max": "4 * b * heads * chunk * (2 * dim_head + 2)",
+            },
+        },
+    },
     "zigzag": {
         "description": "Llama-3 CP: gather K and V once; grads flow back "
                        "through the gather transpose (reduce-scatter)",
@@ -613,7 +638,7 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
     bucket = max(seq // dims["world"] // 2, 4)
 
     if strategy in ("ring", "striped", "counter", "ring_compressed",
-                    "counter_compressed"):
+                    "counter_compressed", "counter_q8"):
         ring_kwargs = contract.get("ring_kwargs", {})
 
         def core(q, k, v):
@@ -1043,7 +1068,7 @@ def run_contract_suite(strategies=None, *, scan: bool = True,
 
 def collective_fingerprint(
     strategies=("ring", "ulysses", "hybrid", "counter", "ring_compressed",
-                "blockwise_ffn"),
+                "counter_q8", "blockwise_ffn"),
 ) -> dict:
     """Compact comms signature for the bench JSON: per-strategy forward
     collective counts from compiled HLO, so a perf trajectory catches a

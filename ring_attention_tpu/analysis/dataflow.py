@@ -482,6 +482,12 @@ class PrecisionFlow(JaxprWalker):
                     f"softmax/accumulator reductions must execute in "
                     f"float32 [rule: f32-accumulator-flow]"
                 )
+            if _is_float(op_dtype) and any(INT8Q in v for v in in_vals):
+                # a dropped QK^T dequant reaches the softmax max/exp first
+                self.findings.append(
+                    f"quantized int8 content reaches {name} without a "
+                    f"dequantization scale at {site} [rule: int8-dequant]"
+                )
             self.sinks_checked.append(f"{name}@{site}")
         if name == "dot_general":
             out_dtype = _dtype_of(eqn.outvars[0].aval)
@@ -491,7 +497,17 @@ class PrecisionFlow(JaxprWalker):
                     f"matmul accumulation must target float32 "
                     f"(preferred_element_type) [rule: f32-accumulator-flow]"
                 )
-            if any(INT8Q in v for v in in_vals[:2]) and _is_float(out_dtype):
+            operand_dtypes = [
+                _dtype_of(getattr(a, "aval", None)) for a in eqn.invars[:2]
+            ]
+            if all(_is_int8(t) for t in operand_dtypes):
+                # the int8 compute path's own matmul: int8 x int8 into an
+                # f32 accumulator is the LEGAL quantized form — its output
+                # is still quantized content (the taint propagates through
+                # the transfer) until the per-row/per-block scale multiply
+                # strips it; accumulating it unscaled is caught below
+                pass
+            elif any(INT8Q in v for v in in_vals[:2]) and _is_float(out_dtype):
                 self.findings.append(
                     f"quantized int8 operand reaches dot_general without a "
                     f"dequantization scale at {site} [rule: int8-dequant]"
@@ -575,6 +591,42 @@ def _producing_arithmetic(jaxpr, outvar, _depth: int = 0):
         # structure-only primitives are pass-through)
         return e
     return None
+
+
+def count_int8_quantize_ops(closed_jaxpr, *, skip_pallas: bool = True) -> int:
+    """Number of float→int8 quantization casts in a program.
+
+    Counts ``convert_element_type`` equations whose input is float and
+    whose output is int8 — the one cast every absmax codec in
+    ``ops/quant.py`` ends with (bool/int flag casts don't match).  Kernel
+    bodies are skipped by default (``skip_pallas``): the in-kernel ``p``
+    quantization is per-tile tile math, not a payload quantization.
+
+    This is the requant pin behind the dequant-free ring composition
+    (``docs/precision.md``): a counter-rotated int8 ring with
+    ``compute_dtype="int8"`` must quantize each KV payload exactly ONCE
+    at ring entry (2 casts — k and v) plus one q cast per hop's launcher;
+    a dequant→requant round trip would add two more per hop and fails the
+    pinned count (``tests/test_quant.py``).
+    """
+
+    def walk(jaxpr) -> int:
+        n = 0
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "pallas_call" and skip_pallas:
+                continue
+            if name == "convert_element_type":
+                in_dtype = _dtype_of(getattr(eqn.invars[0], "aval", None))
+                out_dtype = _dtype_of(getattr(eqn.outvars[0], "aval", None))
+                if _is_float(in_dtype) and _is_int8(out_dtype):
+                    n += 1
+            for v in eqn.params.values():
+                for sub in _sub_closed_jaxprs(v):
+                    n += walk(sub)
+        return n
+
+    return walk(_as_jaxpr(closed_jaxpr))
 
 
 def audit_precision_flow(fn: Callable, *args, label: str | None = None,
@@ -696,6 +748,45 @@ def run_precision_suite() -> list[tuple[str, list[str]]]:
             )[0].astype(jnp.float32).sum(),
             q, kv, kv, label="pallas_flash_decode_q8",
         ),
+    ))
+
+    # the int8 COMPUTE path (PR 13): quantized QK^T/PV inside the flash
+    # kernels — the walker descends into the kernel jaxprs and must see
+    # every int8 matmul output meet its scale multiply before any
+    # reduction/accumulation, and the f32 (acc, m, l) refs untouched
+    def pallas_q8_step(q, k, v):
+        return jax.grad(
+            lambda q, k, v: pallas_flash.pallas_flash_attention(
+                q, k, v, causal=True, interpret=True, compute_dtype="int8",
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    checks.append((
+        "precision: pallas flash int8 compute fwd + bf16 bwd",
+        audit_precision_flow(pallas_q8_step, q, kv, kv,
+                             label="pallas_flash_attention[int8]"),
+    ))
+
+    from ..ops import quant
+
+    def q8_hop_feed(q, k, v):
+        # the dequant-free ring composition: pack once with kernel-ready
+        # v scales, feed the int8 kernel DIRECTLY (no dequant→requant),
+        # finalize from the f32 partials
+        payload = quant.pack_kv(k, v, v_block=8)
+        feed = quant.payload_kernel_feed(payload, 8)
+        p = pallas_flash.pallas_flash_partials(
+            q, None, None, scale=d ** -0.5, causal_offset=0,
+            compute_dtype="int8", kv_quantized=feed, block_q=8, block_k=8,
+            interpret=True,
+        )
+        out, lse = pallas_flash.finalize_partials(p)
+        return out.sum() + lse.sum()
+
+    checks.append((
+        "precision: int8 hop payload -> dequant-free kernel feed",
+        audit_precision_flow(q8_hop_feed, q, kv, kv, label="q8_hop_feed"),
     ))
     return checks
 

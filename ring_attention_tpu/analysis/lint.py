@@ -70,6 +70,17 @@ past PR, with the shim/convention that prevents it:
          chaos harness's hard-death points are the ONE sanctioned
          user).  Legitimate uses elsewhere (liveness probes) carry a
          reasoned allow.
+  RA012  raw int8 quant/dequant arithmetic outside the ``ops/quant.py``
+         seam — any arithmetic use of the int8 full-scale constant 127
+         (the absmax divide, the round-and-clip scale, the dequant
+         multiply) in library code.  Three call sites grew three copies
+         of this codec across PRs (decode cache, hop payload, kernel
+         compute); PR 13 collapsed them into ``ops/quant.py`` and this
+         rule keeps a fourth from forking the convention — a codec with
+         a subtly different scale or clip silently breaks payload
+         interchangeability and the precision auditor's dequant model.
+         Quantize through the seam; a genuinely unrelated 127 carries a
+         reasoned allow.
 
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
@@ -145,6 +156,11 @@ SIGNAL_MODULES = (
     "utils/resilience.py",
 )
 
+# RA012: the one module allowed to spell the int8 full-scale constant in
+# arithmetic (every quant/dequant codec lives there).
+QUANT_SEAM_MODULE = "ops/quant.py"
+INT8_FULL_SCALE = 127  # ra: allow(RA012 the rule's own definition of the constant)
+
 _ALLOW_RE = re.compile(r"#\s*ra:\s*allow\(\s*(RA\d{3})\b([^)]*)\)")
 
 
@@ -196,6 +212,7 @@ class _Linter(ast.NodeVisitor):
         self.in_signal_scope = any(
             m in rel.replace("\\", "/") for m in SIGNAL_MODULES
         )
+        self.in_quant_seam = rel.replace("\\", "/").endswith(QUANT_SEAM_MODULE)
         self.traced_pkg = any(
             rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
             or f"/{p}/" in rel.replace("\\", "/")
@@ -329,6 +346,19 @@ class _Linter(ast.NodeVisitor):
                           "an unitless series reads as whatever the "
                           "dashboard author guesses")
 
+        self.generic_visit(node)
+
+    # -- RA012: int8 quant arithmetic outside the seam ------------------
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if (not self.in_quant_seam
+                and isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool)
+                and abs(node.value) == INT8_FULL_SCALE):
+            self.flag(node, "RA012",
+                      "int8 full-scale constant 127 outside ops/quant.py — "
+                      "raw quant/dequant arithmetic forks the codec seam; "
+                      "quantize through ops.quant (or allow with a reason "
+                      "if this 127 is unrelated to quantization)")
         self.generic_visit(node)
 
     def visit_With(self, node: ast.With) -> None:

@@ -64,6 +64,7 @@ HARDWARE_SERIES = {
     "train1m_tokens_per_sec": ("train1m_tokens_per_sec", +1),
     "hybrid262k_tflops": ("hybrid262k", +1),
     "counter262k_tflops": ("counter262k", +1),
+    "fwd262k_q8_tflops": ("fwd262k_q8", +1),
     "packed262k_tokens_per_sec": ("packed262k", +1),
     "decode_ms_per_token": ("decode_ms_per_token", -1),
 }
@@ -90,6 +91,15 @@ COMMS_REFERENCE: dict[str, dict[str, Any]] = {
         ring_size=8, seq_len=262144, kv_heads=8, dim_head=64,
         dtype_bytes=2, counter_rotate=True, hop_compression="int8",
     ),
+    # PR 13: the int8 COMPUTE path at the north-star shape — identical
+    # wire accounting to counter8_262k_int8 (the quantized matmuls change
+    # the kernel FEED, never the collectives) plus the operand-bytes /
+    # f32-accumulator-bytes keys the q8 bench phase reports
+    "ring8_262k_q8": dict(
+        ring_size=8, seq_len=262144, kv_heads=8, dim_head=64,
+        dtype_bytes=2, counter_rotate=True, hop_compression="int8",
+        compute_dtype="int8",
+    ),
 }
 
 # ring_comms_accounting keys kept per reference config (all exact ints).
@@ -97,6 +107,10 @@ COMMS_KEYS = (
     "ring_hops", "pure_ring_hops", "hop_bytes", "q_pack_bytes",
     "fwd_collectives", "bwd_collectives", "ring_bytes_per_step",
     "ring_bytes_per_step_bwd", "a2a_bytes_per_step",
+    # PR 13: the matmul feed (operand width tracks compute_dtype) and the
+    # f32 (acc, m, l) state (invariant under every compute_dtype — the
+    # precision auditor's contract as a pinned number)
+    "matmul_operand_bytes", "accumulator_bytes",
 )
 
 
@@ -346,7 +360,7 @@ def collect_current(
     *,
     strategies: tuple[str, ...] | None = (
         "ring", "ulysses", "hybrid", "counter", "ring_compressed",
-        "blockwise_ffn",
+        "counter_q8", "blockwise_ffn",
     ),
     compiled: bool = True,
     coverage: bool = True,

@@ -152,6 +152,15 @@ class RingAttention(nn.Module):
     # d/(d+4)-x fewer bytes per hop; quantized once at ring entry, f32
     # accumulators untouched (parallel/collectives.quantize_ring_payload)
     ring_hop_compression: str | None = None
+    # "int8": run the forward's QK^T and PV matmuls on int8 operands
+    # (v5e/v5p MXUs run int8 at ~2x bf16 peak) with per-row q/k and
+    # per-KV-block v absmax scales, f32 (acc, m, l) untouched; the
+    # backward stays bf16 from the exact residuals this round.  Pallas
+    # kernels only — requires impl="pallas"/use_pallas on the "ring" or
+    # "hybrid" strategies (or the local path); composes with
+    # ring_hop_compression="int8" into the dequant-free ring
+    # (docs/precision.md)
+    compute_dtype: str | None = None
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -229,6 +238,36 @@ class RingAttention(nn.Module):
         from ..utils import resilience
 
         return resilience.resolve_attention_impl(self.impl) == "pallas"
+
+    def _compute_dtype(self) -> str | None:
+        """Validated int8-compute knob for this call.
+
+        ``"int8"`` needs the Pallas kernels (the XLA/oracle paths have no
+        int8 matmul form) and a strategy that lowers onto them — the
+        local path, "ring", or "hybrid".  A config that silently ran the
+        quantized model at bf16 would misreport every perf number, so
+        mismatches raise rather than degrade (docs/precision.md)."""
+        if self.compute_dtype is None:
+            return None
+        if self.compute_dtype != "int8":
+            raise ValueError(
+                f"RingAttention: compute_dtype={self.compute_dtype!r}; "
+                'supported values are None and "int8"'
+            )
+        if self.force_regular_attn or not self._use_pallas():
+            raise ValueError(
+                'compute_dtype="int8" runs on the Pallas kernels only — '
+                "set impl=\"pallas\"/use_pallas=True (and drop "
+                "force_regular_attn)"
+            )
+        if (self._ring_size() > 1 and self.use_ring
+                and self.sequence_parallel not in ("ring", "hybrid")):
+            raise ValueError(
+                f'compute_dtype="int8" supports the "ring" and "hybrid" '
+                f"strategies (and the local path); got "
+                f'sequence_parallel="{self.sequence_parallel}"'
+            )
+        return "int8"
 
     def _ring_size(self) -> int:
         """Total sequence-parallel world (over BOTH axes of a factored mesh)."""
@@ -409,6 +448,7 @@ class RingAttention(nn.Module):
                 softclamp_value=self.softclamp_value,
                 head_chunks=self.pallas_head_chunks,
                 segment_ids=segment_ids, doc_starts=doc_starts,
+                compute_dtype=self._compute_dtype(),
             )
         return flash_attention(
             q, k, v, mask, causal=causal, bucket_size=self.bucket_size,
@@ -572,6 +612,7 @@ class RingAttention(nn.Module):
                 segment_ids=seg,
                 counter_rotate=self.ring_counter_rotate,
                 hop_compression=self.ring_hop_compression,
+                compute_dtype=self._compute_dtype(),
             )
 
         qspec = P(DATA_AXIS, None, seq_partition(self.mesh), None)
@@ -611,6 +652,7 @@ class RingAttention(nn.Module):
                 segment_ids=seg,
                 counter_rotate=self.ring_counter_rotate,
                 hop_compression=self.ring_hop_compression,
+                compute_dtype=self._compute_dtype(),
             )
 
         qspec = P(DATA_AXIS, None, SEQ_AXIS, None)

@@ -121,6 +121,10 @@ class RingTransformer(nn.Module):
     # see RingAttention.ring_counter_rotate / ring_hop_compression
     ring_counter_rotate: bool = False
     ring_hop_compression: str | None = None
+    # see RingAttention.compute_dtype: "int8" runs every layer's forward
+    # QK^T/PV on int8 operands (pallas path, ring/hybrid/local), backward
+    # bf16 from exact residuals (docs/precision.md)
+    compute_dtype: str | None = None
     # rematerialize each block in backward: trades recompute for activation
     # memory — the standard recipe for quarter-million-token training.
     # NOTE: requires the train step to be jit-compiled (jax.checkpoint over
@@ -214,6 +218,7 @@ class RingTransformer(nn.Module):
                 ring_dkv_dtype=self.ring_dkv_dtype,
                 ring_counter_rotate=self.ring_counter_rotate,
                 ring_hop_compression=self.ring_hop_compression,
+                compute_dtype=self.compute_dtype,
                 dtype=self.dtype,
             )
             for attn_cls, lookback, layer_mask in zip(

@@ -25,6 +25,8 @@ from .pallas_flash import (
     pallas_flash_decode_q8,
     quantize_kv_cache,
 )
+from . import quant
+from .quant import QuantizedBlockKV
 from .rotary import apply_rotary, ring_positions, rotary_freqs, rotate_half
 from .. import masks as _masks
 
@@ -45,6 +47,7 @@ def attention(
     interpret: bool | None = None,
     segment_ids=None,
     doc_starts: tuple[int, ...] | None = None,
+    compute_dtype: str | None = None,
 ):
     """Single-device attention entry point with graceful kernel degradation.
 
@@ -82,6 +85,13 @@ def attention(
     ``head_chunks``/``interpret``/``doc_starts`` to the Pallas path; both
     sets are legal with ``impl="auto"`` (whichever path runs uses its
     own).  ``segment_ids`` (packed sequences) applies to both.
+
+    ``compute_dtype="int8"`` (quantized QK^T/PV, ``docs/precision.md``)
+    exists only on the Pallas kernels, so it suspends the graceful
+    degradation: ``impl="xla"`` raises, and ``"auto"`` requires the probe
+    to resolve Pallas and lets kernel failures PROPAGATE — silently
+    falling back to bf16 compute would misreport every number a
+    quantized run exists to measure.
     """
     from ..utils import resilience
     from ..utils.validate import check_attention_args
@@ -158,10 +168,24 @@ def attention(
             q, k, v, mask, causal=causal, window=window,
             softclamp_value=softclamp_value, head_chunks=head_chunks,
             interpret=interpret, segment_ids=segment_ids,
-            doc_starts=doc_starts,
+            doc_starts=doc_starts, compute_dtype=compute_dtype,
         )
 
     resolved = resilience.resolve_attention_impl(impl)
+    if compute_dtype is not None:
+        if compute_dtype != "int8":
+            raise ValueError(
+                f"attention: compute_dtype={compute_dtype!r}; supported "
+                'values are None and "int8"'
+            )
+        if resolved == "xla":
+            raise ValueError(
+                'attention: compute_dtype="int8" runs on the Pallas '
+                f'kernels only, but impl={impl!r} resolved to the XLA '
+                "path — a silent bf16 fallback would misreport a "
+                "quantized run (docs/precision.md)"
+            )
+        return run_pallas()  # failures propagate: no bf16 degradation
     if resolved == "xla":
         return run_xla()
     if impl != "auto":
@@ -185,6 +209,8 @@ __all__ = [
     "BandPlan",
     "band_plan",
     "QuantizedKV",
+    "QuantizedBlockKV",
+    "quant",
     "pallas_flash_attention",
     "pallas_flash_decode",
     "pallas_flash_decode_q8",

@@ -48,6 +48,8 @@ from jax.experimental.pallas import tpu as pltpu
 import bisect
 
 from .attention import EPSILON, MASK_VALUE, normalize_segment_ids
+from . import quant as _quant
+from .quant import QuantizedBlockKV
 from ..utils import compat
 from ..utils.validate import check_attention_args
 
@@ -661,7 +663,12 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, segmented: bool,
     Ref layout (pallas passes scalar-prefetch, inputs, outputs, scratch
     positionally; the static flags say which are present):
       scalars: offs (+ tq/tk/tf tile tables when ``compact``)
-      inputs:  q, k, v (+ kv mask when ``masked``)
+      inputs:  q, k, v (+ q/k/v dequant scales when the tile kwargs
+               carry ``quantized`` — the int8 compute path: q/k/v are
+               int8 values; the q/k scales are per-ROW f32 vectors
+               ((1, bq)/(1, bk) blocks), the v scale a (1, 1) per-block
+               scalar)
+               (+ kv mask when ``masked``)
                (+ q/kv segment ids when ``segmented`` — packed sequences
                 masked in-kernel; a block-aligned declared layout resolves
                 them into the compact tables instead and ships no refs)
@@ -683,6 +690,10 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, segmented: bool,
         idx = 1
     q_ref, k_ref, v_ref = refs[idx:idx + 3]
     idx += 3
+    scale_refs = None
+    if tile_kw.get("quantized"):
+        scale_refs = refs[idx:idx + 3]
+        idx += 3
     kvm_ref = refs[idx] if masked else None
     idx += 1 if masked else 0
     qseg_ref = kseg_ref = None
@@ -723,7 +734,8 @@ def _fwd_kernel(*refs, compact: bool, masked: bool, segmented: bool,
             l[:] = jnp.zeros_like(l)
 
     tile = _tile_closure(_fwd_tile, tile_kw, offs_ref, q_ref, k_ref, v_ref,
-                         kvm_ref, qseg_ref, kseg_ref, acc, m, l, row0, col0)
+                         kvm_ref, qseg_ref, kseg_ref, scale_refs, acc, m, l,
+                         row0, col0)
     if compact:
         _dispatch_tile_compact(tf, tile)
     else:
@@ -751,36 +763,72 @@ def _softclamp_grad_factor(s_clamped, clamp, exp2):
     return 1.0 - (s_nat / clamp) ** 2
 
 
-def _online_update(s, v, acc, m, l, exp2=False):
+def _online_update(s, v, acc, m, l, exp2=False, v_scale=None):
     """One online-softmax accumulator step over a masked score tile ``s``
     against value rows ``v`` — THE shared tile math of every forward-shaped
     kernel in this module (``p`` is cast to ``v.dtype`` so bf16 callers run
     the pv matmul in bf16 and f32 callers in f32).  With ``exp2`` the tile
     is in log2 space (s and m both scaled by log2e), so ``p``/``alpha``/
-    ``l``/``acc`` come out value-identical with a cheaper exponential."""
+    ``l``/``acc`` come out value-identical with a cheaper exponential.
+
+    ``v_scale`` (a per-tile f32 scalar) selects the int8 PV path: ``v``
+    is then int8 values whose block dequant scale is ``v_scale``, ``p``
+    quantizes to int8 per row (``quant.quantize_p`` — per-row absmax, so
+    late tiles whose ``p`` is small against the RUNNING max keep their
+    resolution), the PV matmul runs on int8 operands into an f32
+    accumulator, and the dequant factors fold into one ``(bq, 1)``
+    multiply on the product (the per-row p scale rides the free index;
+    ``v_scale`` is scalar).  ``l`` sums the SAME quantized ``p`` so
+    ``out = acc / l`` stays exactly normalized over the weights actually
+    applied."""
     ex = jnp.exp2 if exp2 else jnp.exp
     m_prev = m[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     p = ex(s - m_new)
     alpha = ex(m_prev - m_new)
-    l[:] = l[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    pv = lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc[:] = acc[:] * alpha + pv
+    if v_scale is None:
+        l[:] = l[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * alpha + pv
+    else:
+        p8, p_scale = _quant.quantize_p(p)
+        # scale BEFORE the row-sum on purpose: reassociating to
+        # sum(p8) * p_scale is value-identical but would accumulate
+        # undequantized int8 content — the exact pattern the precision
+        # auditor forbids (dequant-before-reduce, no exceptions)
+        l[:] = l[:] * alpha + jnp.sum(
+            p8.astype(jnp.float32) * p_scale, axis=1, keepdims=True,
+        )
+        pv8 = lax.dot_general(
+            p8, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * alpha + pv8 * (p_scale * v_scale)
     m[:] = m_new
 
 
 def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, qseg_ref, kseg_ref,
-              acc, m, l, row0, col0, *, scale, softclamp_value, causal,
-              windowed, masked, segmented, bq, bk, exp2=False):
+              scale_refs, acc, m, l, row0, col0, *, scale, softclamp_value,
+              causal, windowed, masked, segmented, bq, bk, exp2=False,
+              quantized=False):
     q = q_ref[0]
     k = k_ref[0]
     s = lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    if scale != 1.0:  # static: folded into q for power-of-two scales
+    if quantized:
+        # int8 operands: s is the raw int8 QK^T accumulated in f32.  The
+        # q/k scales ride the matmul's FREE indices (per-row absmax —
+        # row/col vectors on the score tile), so dequantization is exact
+        # and the softmax scale (and the log2-space basis factor) folds
+        # into the same ONE fused rescale multiply (docs/precision.md)
+        qs_ref, ks_ref, _ = scale_refs
+        s = s * ((qs_ref[0] * (scale * LOG2E if exp2 else scale))[:, None]
+                 * ks_ref[0][None, :])
+    elif scale != 1.0:  # static: folded into q for power-of-two scales
         s = s * scale
     if softclamp_value is not None:
         s = _softclamp(s, softclamp_value, exp2)
@@ -794,7 +842,10 @@ def _fwd_tile(offs_ref, q_ref, k_ref, v_ref, kvm_ref, qseg_ref, kseg_ref,
     if keep is not None:
         s = jnp.where(keep, s, MASK_VALUE)
 
-    _online_update(s, v_ref[0], acc, m, l, exp2=exp2)
+    _online_update(
+        s, v_ref[0], acc, m, l, exp2=exp2,
+        v_scale=scale_refs[2][0, 0] if quantized else None,
+    )
 
 
 class FlashPartials(NamedTuple):
@@ -810,7 +861,7 @@ def _flash_fwd_call(
     scale, causal_offset, window_lo, softclamp_value,
     block_q, block_k, band_hint, interpret, fused, carry=None,
     exp2=None, q_segment_ids=None, kv_segment_ids=None, doc_starts=None,
-    name=None,
+    compute_dtype=None, kv_quantized=None, name=None,
 ):
     """Shared forward launcher: one flash sweep over a KV span.
 
@@ -827,9 +878,29 @@ def _flash_fwd_call(
     layout statically, and when it lands on block boundaries under a
     compact causal grid the cross-document tiles are dropped from the grid
     at trace time instead (no refs, no per-tile mask) — misaligned or
-    demoted layouts fall back to the in-kernel mask."""
+    demoted layouts fall back to the in-kernel mask.
+
+    ``compute_dtype="int8"`` runs QK^T and PV on int8 operands: q is
+    quantized per q-block and k/v per KV-block (symmetric absmax,
+    ``ops/quant.py``), the dequant-scale multiply folds into the per-tile
+    softmax rescale, ``p`` quantizes at the fixed full scale for the PV
+    matmul, and the ``(acc, m, l)`` state stays f32 end to end
+    (``docs/precision.md``).  ``kv_quantized`` (a
+    :class:`~ring_attention_tpu.ops.quant.QuantizedBlockKV` whose
+    ``block`` equals this launch's fitted ``block_k``) feeds
+    pre-quantized K/V directly — the ring's dequant-free hop composition;
+    ``k``/``v`` may then be None."""
     b, h, nq, d = q.shape
-    _, hk, nk, _ = k.shape
+    if compute_dtype not in (None, "int8"):
+        raise ValueError(
+            f"compute_dtype={compute_dtype!r}: supported values are None "
+            '(model-dtype matmuls) and "int8" (quantized QK^T/PV)'
+        )
+    quantized = compute_dtype == "int8"
+    if kv_quantized is not None and not quantized:
+        raise ValueError('kv_quantized requires compute_dtype="int8"')
+    kshape = (kv_quantized.k_q if kv_quantized is not None else k).shape
+    _, hk, nk, _ = kshape
     g = h // hk
     bq, bk = _block_sizes(nq, nk, block_q, block_k)
     interpret = _interpret_default() if interpret is None else interpret
@@ -846,7 +917,12 @@ def _flash_fwd_call(
     # capture, see _exp2_default) moves the whole tile into log2 space
     # (fold scale*log2e, exponentials become exp2).
     exp2 = _exp2_default() if exp2 is None else bool(exp2)
-    if exp2:
+    if quantized:
+        # int8 q cannot absorb a float fold; the softmax scale (and the
+        # log2-space basis factor) ride the per-tile dequant multiply
+        # instead — see _fwd_tile's quantized branch
+        pass
+    elif exp2:
         q = q * jnp.asarray(scale * LOG2E, q.dtype)
         scale = 1.0
     elif scale != 1.0 and math.frexp(float(scale))[0] == 0.5:
@@ -905,6 +981,7 @@ def _flash_fwd_call(
         bq=bq,
         bk=bk,
         exp2=exp2,
+        quantized=quantized,
     )
 
     if compact:
@@ -923,6 +1000,14 @@ def _flash_fwd_call(
         grid = (b * h, tq_a.shape[0])
         q_map, kv_map, kvm_map, qm_map, _ = _compact_maps(h, hk, g)
         semantics = ("parallel", "arbitrary")
+
+        def qsc_map(bh, t, offs, tq, tk, tf):
+            return (bh, tq[t])
+
+        def ksc_map(bh, t, offs, tq, tk, tf):
+            return ((bh // h) * hk + (bh % h) // g, tk[t])
+
+        vsc_map = ksc_map  # v block scales index like k rows, block (1, 1)
     else:
         q, k, v, kv_mask, q_segment_ids, kv_segment_ids, offs = _unify_vma(
             q, k, v, kv_mask, q_segment_ids, kv_segment_ids, offs
@@ -942,6 +1027,14 @@ def _flash_fwd_call(
         def qm_map(bh, qi, ki, *_):
             return (bh // h, qi)
 
+        def qsc_map(bh, qi, ki, *_):
+            return (bh, qi)
+
+        def ksc_map(bh, qi, ki, *_):
+            return ((bh // h) * hk + (bh % h) // g, ki)
+
+        vsc_map = ksc_map
+
         # batch*head and q-block grid dims are independent (megacore can
         # split them); the kv dim carries the online-softmax state
         semantics = ("parallel", "parallel", "arbitrary")
@@ -955,9 +1048,37 @@ def _flash_fwd_call(
         **common,
     )
 
-    qr = q.reshape(b * h, nq, d)
-    kr = k.reshape(b * hk, nk, d)
-    vr = v.reshape(b * hk, nk, d)
+    if quantized:
+        # q quantizes per row HERE (it is exact bf16 at every call site —
+        # ring hops re-quantize the rotating pack's q, cheap VPU work);
+        # k/v either arrive pre-quantized (the ring's dequant-free hop
+        # feed) or quantize now — k per row (a FREE index of QK^T, so the
+        # scale pulls out exactly), v per KV-block (PV contracts over
+        # tokens; only a per-block scalar pulls out of that matmul).
+        qr, qs = _quant.quantize_rows(q.reshape(b * h, nq, d))
+        if kv_quantized is not None:
+            if kv_quantized.block != bk:
+                raise ValueError(
+                    f"kv_quantized was packed at v-block "
+                    f"{kv_quantized.block} but this launch fitted "
+                    f"block_k={bk}; quantize at the kernel's fitted block "
+                    f"(see parallel/ring.py)"
+                )
+            kr = kv_quantized.k_q.reshape(b * hk, nk, d)
+            vr = kv_quantized.v_q.reshape(b * hk, nk, d)
+            ks = kv_quantized.k_scale.reshape(b * hk, nk)
+            vs = kv_quantized.v_scale.reshape(b * hk, nk // bk)
+        else:
+            kr, ks = _quant.quantize_rows(k.reshape(b * hk, nk, d))
+            vr, vs = _quant.quantize_blocks(v.reshape(b * hk, nk, d), bk)
+        qs, ks, vs, kr, vr = (_unify_vma(x, q)[0] for x in (qs, ks, vs, kr, vr))
+        qs = qs.astype(jnp.float32)
+        ks = ks.astype(jnp.float32)
+        vs = vs.astype(jnp.float32)
+    else:
+        qr = q.reshape(b * h, nq, d)
+        kr = k.reshape(b * hk, nk, d)
+        vr = v.reshape(b * hk, nk, d)
 
     in_specs = [
         pl.BlockSpec((1, bq, d), q_map, memory_space=pltpu.VMEM),
@@ -965,6 +1086,13 @@ def _flash_fwd_call(
         pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
     ]
     inputs = [qr, kr, vr]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bq), qsc_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), ksc_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), vsc_map, memory_space=pltpu.VMEM),
+        ]
+        inputs += [qs, ks, vs]
     if masked:
         kvm = kv_mask.astype(jnp.int8)
         in_specs.append(pl.BlockSpec((1, bk), kvm_map, memory_space=pltpu.VMEM))
@@ -1031,6 +1159,8 @@ def _flash_fwd_call(
         name = "flash_fwd_tile" if fused else "flash_partials_tile"
         if resume:
             name += "_resume"
+        if quantized:
+            name += "_q8"  # int8 sweeps attribute separately in XProf
     results = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1071,6 +1201,8 @@ def pallas_flash_partials(  # ra: allow(RA007 per-hop kernel launch; ring/zigzag
     exp2: bool | None = None,
     segment_ids=None,
     doc_starts: tuple[int, ...] | None = None,
+    compute_dtype: str | None = None,
+    kv_quantized: QuantizedBlockKV | None = None,
 ) -> FlashPartials:
     """One flash sweep over a KV span, returning mergeable partials.
 
@@ -1089,9 +1221,17 @@ def pallas_flash_partials(  # ra: allow(RA007 per-hop kernel launch; ring/zigzag
     cross-document pairs for packed sequences; ``doc_starts`` declares the
     packing statically so a block-aligned layout drops cross-document
     tiles from the compact grid at trace time (``docs/packing.md``).
+
+    ``compute_dtype="int8"`` runs QK^T/PV on int8 operands with per-block
+    absmax scales and f32 ``(acc, m, l)`` untouched; ``kv_quantized``
+    feeds pre-quantized K/V directly (the ring's dequant-free hop
+    composition — ``k``/``v`` may then be None).  See
+    ``docs/precision.md``.
     """
     q_seg, kv_seg = normalize_segment_ids(
-        segment_ids, q, k, "pallas_flash_partials"
+        segment_ids, q,
+        kv_quantized.k_q if kv_quantized is not None else k,
+        "pallas_flash_partials",
     )
     return _flash_fwd_call(
         q, k, v, kv_mask,
@@ -1099,7 +1239,8 @@ def pallas_flash_partials(  # ra: allow(RA007 per-hop kernel launch; ring/zigzag
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
         band_hint=band_hint, interpret=interpret, fused=False, carry=carry,
         exp2=exp2, q_segment_ids=q_seg, kv_segment_ids=kv_seg,
-        doc_starts=doc_starts,
+        doc_starts=doc_starts, compute_dtype=compute_dtype,
+        kv_quantized=kv_quantized,
     )
 
 
@@ -1121,6 +1262,8 @@ def pallas_flash_fused(  # ra: allow(RA007 final-hop kernel launch; ring entry p
     exp2: bool | None = None,
     segment_ids=None,
     doc_starts: tuple[int, ...] | None = None,
+    compute_dtype: str | None = None,
+    kv_quantized: QuantizedBlockKV | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Single-span forward with normalization fused into the final kernel
     write: returns ``(out in q.dtype, lse f32)`` directly.
@@ -1144,7 +1287,9 @@ def pallas_flash_fused(  # ra: allow(RA007 final-hop kernel launch; ring entry p
             "pallas_flash_fused: band_hint needs a carry (see docstring)"
         )
     q_seg, kv_seg = normalize_segment_ids(
-        segment_ids, q, k, "pallas_flash_fused"
+        segment_ids, q,
+        kv_quantized.k_q if kv_quantized is not None else k,
+        "pallas_flash_fused",
     )
     return _flash_fwd_call(
         q, k, v, kv_mask,
@@ -1152,7 +1297,8 @@ def pallas_flash_fused(  # ra: allow(RA007 final-hop kernel launch; ring entry p
         softclamp_value=softclamp_value, block_q=block_q, block_k=block_k,
         band_hint=band_hint, interpret=interpret, fused=True, carry=carry,
         exp2=exp2, q_segment_ids=q_seg, kv_segment_ids=kv_seg,
-        doc_starts=doc_starts,
+        doc_starts=doc_starts, compute_dtype=compute_dtype,
+        kv_quantized=kv_quantized,
     )
 
 
@@ -1265,17 +1411,10 @@ class QuantizedKV(NamedTuple):
 
 
 def quantize_kv_cache(k: jax.Array, v: jax.Array) -> QuantizedKV:
-    """Per-token symmetric absmax int8 quantization of a KV cache."""
-
-    def one(x):
-        xf = x.astype(jnp.float32)
-        scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
-        safe = jnp.where(scale > 0, scale, 1.0)
-        xq = jnp.round(xf / safe[..., None])
-        return jnp.clip(xq, -127, 127).astype(jnp.int8), scale
-
-    k_q, k_scale = one(k)
-    v_q, v_scale = one(v)
+    """Per-token symmetric absmax int8 quantization of a KV cache
+    (``ops/quant.py::quantize_rows`` — the one int8 codec seam)."""
+    k_q, k_scale = _quant.quantize_rows(k)
+    v_q, v_scale = _quant.quantize_rows(v)
     return QuantizedKV(k_q, k_scale, v_q, v_scale)
 
 
@@ -1284,9 +1423,9 @@ def dequantize_kv_cache(
 ) -> tuple[jax.Array, jax.Array]:
     """Materialize the KV a quantized cache represents (the non-pallas
     decode fallback and the parity-test oracle)."""
-    k = kv.k_q.astype(jnp.float32) * kv.k_scale[..., None]
-    v = kv.v_q.astype(jnp.float32) * kv.v_scale[..., None]
-    return k.astype(dtype), v.astype(dtype)
+    k = _quant.dequantize_rows(kv.k_q, kv.k_scale, dtype)
+    v = _quant.dequantize_rows(kv.v_q, kv.v_scale, dtype)
+    return k, v
 
 
 def _decode_q8_kernel(q_ref, kq_ref, ks_ref, vq_ref, vs_ref, *rest,
@@ -1720,6 +1859,7 @@ def pallas_flash_backward(
     exp2: bool | None = None,
     segment_ids=None,
     doc_starts: tuple[int, ...] | None = None,
+    compute_dtype: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-pass flash backward. Returns (dq, dk, dv), all f32, dk/dv with
     ``hk`` heads (GQA group-summed).
@@ -1733,7 +1873,19 @@ def pallas_flash_backward(
     cross-document terms drop out of ``p`` in both passes, and a
     block-aligned declared layout drops cross-document tiles from each
     pass's compact grid at trace time (checked against that pass's block
-    sizes independently)."""
+    sizes independently).
+
+    ``compute_dtype`` is the knob SURFACE for the int8 backward; this
+    round only ``None`` (bf16 matmuls) is implemented — the dk/dv/dq
+    error budget does not yet admit int8 recompute (docs/precision.md §5),
+    so an int8-forward model differentiates through exact-residual bf16
+    backward passes."""
+    if compute_dtype is not None:
+        raise NotImplementedError(
+            f"pallas_flash_backward: compute_dtype={compute_dtype!r} — the "
+            "backward runs bf16 this round (dk/dv/dq error bounds, "
+            "docs/precision.md §5); pass compute_dtype=None"
+        )
     b, h, nq, d = q.shape
     _, hk, nk, _ = k.shape
     g = h // hk
@@ -2048,19 +2200,22 @@ def pallas_flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13)
+)
 def _pallas_flash_core(q, k, v, kv_mask, q_seg, kv_seg, scale, causal_offset,
-                       window, softclamp_value, interpret, exp2, doc_starts):
+                       window, softclamp_value, interpret, exp2, doc_starts,
+                       compute_dtype=None):
     out, _ = _pallas_flash_fwd_impl(
         q, k, v, kv_mask, q_seg, kv_seg, scale, causal_offset, window,
-        softclamp_value, interpret, exp2, doc_starts
+        softclamp_value, interpret, exp2, doc_starts, compute_dtype
     )
     return out
 
 
 def _pallas_flash_fwd_impl(q, k, v, kv_mask, q_seg, kv_seg, scale,
                            causal_offset, window, softclamp_value, interpret,
-                           exp2, doc_starts):
+                           exp2, doc_starts, compute_dtype=None):
     window_lo = causal_offset - (window - 1) if window is not None else None
     # fused finalize: the kernel writes normalized q.dtype output + lse, so
     # the f32 (acc, m, l) triple never touches HBM (512 MB saved per call
@@ -2071,6 +2226,7 @@ def _pallas_flash_fwd_impl(q, k, v, kv_mask, q_seg, kv_seg, scale,
         softclamp_value=softclamp_value, block_q=None, block_k=None,
         band_hint=None, interpret=interpret, fused=True, exp2=exp2,
         q_segment_ids=q_seg, kv_segment_ids=kv_seg, doc_starts=doc_starts,
+        compute_dtype=compute_dtype,
     )
     # named residuals: lets a remat policy save (out, lse) so the backward's
     # residual recompute elides this kernel (see parallel/ring.py, same names)
@@ -2081,16 +2237,20 @@ def _pallas_flash_fwd_impl(q, k, v, kv_mask, q_seg, kv_seg, scale,
 
 def _pallas_flash_core_fwd(q, k, v, kv_mask, q_seg, kv_seg, scale,
                            causal_offset, window, softclamp_value, interpret,
-                           exp2, doc_starts):
+                           exp2, doc_starts, compute_dtype=None):
     out, lse = _pallas_flash_fwd_impl(
         q, k, v, kv_mask, q_seg, kv_seg, scale, causal_offset, window,
-        softclamp_value, interpret, exp2, doc_starts
+        softclamp_value, interpret, exp2, doc_starts, compute_dtype
     )
     return out, (q, k, v, kv_mask, q_seg, kv_seg, out, lse)
 
 
 def _pallas_flash_core_bwd(scale, causal_offset, window, softclamp_value,
-                           interpret, exp2, doc_starts, res, do):
+                           interpret, exp2, doc_starts, compute_dtype, res,
+                           do):
+    # the backward stays bf16 regardless of the forward's compute_dtype
+    # this round: it recomputes scores from the EXACT residual (q, k, v)
+    # — int8 touched only the forward's (out, lse) — docs/precision.md §5
     q, k, v, kv_mask, q_seg, kv_seg, out, lse = res
     window_lo = causal_offset - (window - 1) if window is not None else None
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
@@ -2123,6 +2283,7 @@ def pallas_flash_attention(
     exp2: bool | None = None,
     segment_ids=None,
     doc_starts: tuple[int, ...] | None = None,
+    compute_dtype: str | None = None,
 ) -> jax.Array:
     """Exact flash attention on the Pallas TPU kernel path (GQA-aware).
 
@@ -2145,6 +2306,11 @@ def pallas_flash_attention(
     land on the kernel block sizes, cross-document tiles leave the compact
     causal grid at trace time (skipped, not masked); see
     ``docs/packing.md`` for the contract.
+
+    ``compute_dtype="int8"`` quantizes the FORWARD's QK^T/PV matmul
+    operands (per-block absmax, f32 accumulators untouched); the backward
+    stays bf16 from the exact residuals — fwd error ≤ the int8-hop bound
+    (``docs/precision.md``).
     """
     check_attention_args("pallas_flash_attention", q, k, v, mask)
     q_seg, kv_seg = normalize_segment_ids(
@@ -2178,12 +2344,12 @@ def pallas_flash_attention(
                 k[:, i * hk_c:(i + 1) * hk_c],
                 v[:, i * hk_c:(i + 1) * hk_c],
                 mask, q_seg, kv_seg, scale, causal_offset, window,
-                softclamp_value, interpret, exp2, doc_starts,
+                softclamp_value, interpret, exp2, doc_starts, compute_dtype,
             )
             for i in range(head_chunks)
         ]
         return jnp.concatenate(outs, axis=1)
     return _pallas_flash_core(
         q, k, v, mask, q_seg, kv_seg, scale, causal_offset, window,
-        softclamp_value, interpret, exp2, doc_starts,
+        softclamp_value, interpret, exp2, doc_starts, compute_dtype,
     )

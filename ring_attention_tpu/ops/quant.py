@@ -1,0 +1,216 @@
+"""The int8 quantization seam: every absmax codec in the package.
+
+Three call sites grew their own int8 arithmetic across PRs — the decode
+cache (``flash_decode_q8``, PR 4 lineage), the ring hop payload
+(``quantize_ring_payload``, PR 6), and now the int8 *compute* path through
+the flash kernels (QK^T and PV on int8 operands).  They all share one
+scheme — symmetric absmax, zero-point-free, full scale ``INT8_MAX = 127``
+— and this module is its single home.  Lint rule RA012 flags raw
+int8 quant/dequant arithmetic (the 127 full-scale constant) anywhere else
+in the package, so a fourth codec cannot silently fork the convention.
+
+Two scale granularities:
+
+- **per-row** (:func:`quantize_rows`): one f32 scale per trailing-axis row
+  — the ``(head, token)`` granularity of the decode cache and the PR 6
+  hop payload.  Most accurate; usable wherever the scale rides a *free*
+  index of the downstream matmul (the QK^T row/col, the decode dequant).
+- **per-block** (:func:`quantize_blocks`): one f32 scale per ``block``
+  tokens (a ``(block, d)`` slab).  This is what the int8 *compute* path
+  needs: PV contracts over the key/token axis, so a per-token v scale
+  cannot be pulled out of the matmul — only a per-KV-block scalar can,
+  and then ``acc += (p8 · v8) * (vs / 127²)`` dequantizes exactly.
+
+The single-array ring payload (:func:`pack_kv` / :func:`unpack_kv`) stays
+shape-compatible across both granularities: per-block scales are
+broadcast to every token row of their block before the bitcast, so a
+block-quantized payload IS a valid row-payload (``unpack_kv`` dequantizes
+it bit-exactly) while :func:`payload_block_scales` recovers the per-block
+scalars for the kernel feed.  One wire format, two consumers — the
+"dequant-free ring composition" seam (``docs/precision.md``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# The symmetric int8 full-scale constant.  THE one place it is spelled:
+# RA012 flags 127-arithmetic outside this module.
+INT8_MAX = 127.0
+
+# Bytes of one bitcast f32 scale appended per payload row (pack_kv).
+SCALE_BYTES = 4
+
+
+def quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric absmax int8 quantization over the LAST axis.
+
+    Returns ``(values int8 like x, scales f32 of x.shape[:-1])`` with
+    ``x ≈ values * scales[..., None]``.  All-zero rows get scale 1.0 (and
+    all-zero values), so dequantization is always finite.
+    """
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / INT8_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    xq = jnp.round(xf / safe[..., None])
+    return jnp.clip(xq, -INT8_MAX, INT8_MAX).astype(jnp.int8), scale
+
+
+def dequantize_rows(values: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """Materialize what a :func:`quantize_rows` pair represents."""
+    return (values.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+def quantize_blocks(x: jax.Array, block: int) -> tuple[jax.Array, jax.Array]:
+    """Per-block symmetric absmax over ``(block, d)`` token slabs.
+
+    ``x`` is ``(..., n, d)`` with ``block`` dividing ``n``; returns
+    ``(values int8 like x, scales f32 of x.shape[:-2] + (n // block,))``
+    — one scalar per block of ``block`` tokens, the granularity the int8
+    flash matmuls dequantize at (a per-tile scalar multiply).
+    """
+    n, d = x.shape[-2], x.shape[-1]
+    if n % block:
+        raise ValueError(
+            f"quantize_blocks: block {block} must divide the token axis {n}"
+        )
+    xf = x.astype(jnp.float32)
+    xb = xf.reshape(*x.shape[:-2], n // block, block, d)
+    scale = jnp.max(jnp.abs(xb), axis=(-2, -1)) / INT8_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    xq = jnp.round(xb / safe[..., None, None])
+    xq = jnp.clip(xq, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return xq.reshape(x.shape), scale
+
+
+def dequantize_blocks(
+    values: jax.Array, scales: jax.Array, block: int, dtype
+) -> jax.Array:
+    """Materialize what a :func:`quantize_blocks` pair represents."""
+    n, d = values.shape[-2], values.shape[-1]
+    vb = values.astype(jnp.float32).reshape(
+        *values.shape[:-2], n // block, block, d
+    )
+    return (vb * scales[..., None, None]).reshape(values.shape).astype(dtype)
+
+
+def quantize_p(p: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize an online-softmax probability tile to int8, per ROW.
+
+    ``p = exp(s - m_new) >= 0``; each row scales by its own absmax
+    (``rowmax / 127``) so late tiles — whose every ``p`` is small against
+    the RUNNING max — keep ~7 bits of resolution instead of rounding to
+    zero (a fixed full-scale quant would drop their contribution
+    entirely).  The per-row scale rides the PV matmul's FREE index, so it
+    pulls out of the contraction exactly: ``acc[i] += (p8 · v8)[i] *
+    (p_scale[i] * v_scale)`` (``ops/pallas_flash.py::_online_update``).
+    Using the same quantized ``p`` for the ``l`` normalizer keeps
+    ``out = acc / l`` exactly normalized over the weights actually
+    applied.  Returns ``(p8 int8, scale (rows, 1) f32)``; all-zero
+    (fully masked) rows get scale 1.0 and zero values.
+    """
+    scale = jnp.max(p, axis=-1, keepdims=True) / INT8_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    return jnp.round(p / safe).astype(jnp.int8), safe
+
+
+class QuantizedBlockKV(NamedTuple):
+    """Quantized K/V operands ready for the int8 flash kernels.
+
+    Scale granularity follows the matmul index each operand's scale must
+    ride: ``k_scale`` is PER ROW — ``(b, hk, n)`` f32, the ``(head,
+    token)`` granularity of the decode cache and the hop payload — since
+    the key/token axis is a FREE index of QK^T (the scale pulls out as a
+    per-column multiply on the score tile); ``v_scale`` is PER KV-BLOCK —
+    ``(b, hk, n // block)`` f32 — since PV *contracts* over tokens and
+    only a per-block scalar pulls out of that matmul.  ``block`` must
+    equal the kernel's fitted ``block_k`` (asserted at launch)."""
+
+    k_q: jax.Array
+    k_scale: jax.Array
+    v_q: jax.Array
+    v_scale: jax.Array
+    block: int
+
+
+def quantize_kv_blocks(k: jax.Array, v: jax.Array, block: int) -> QuantizedBlockKV:
+    """Quantize a K/V pair for the int8 compute path (k per row, v per
+    KV-block — see :class:`QuantizedBlockKV`)."""
+    k_q, k_s = quantize_rows(k)
+    v_q, v_s = quantize_blocks(v, block)
+    return QuantizedBlockKV(k_q, k_s, v_q, v_s, block)
+
+
+# ---------------------------------------------------------------------------
+# The single-array ring payload
+# ---------------------------------------------------------------------------
+
+
+def pack_kv(k: jax.Array, v: jax.Array, *, v_block: int | None = None) -> jax.Array:
+    """Pack a K/V pair into ONE int8 ring-hop payload.
+
+    Returns ``(2, b, hk, n, d + 4)`` int8 — k at index 0, v at index 1,
+    channels ``[0:d]`` the quantized values and ``[d:d+4]`` the per-row
+    f32 scale bitcast into its four bytes (one array = one ``ppermute``
+    per hop; a collective move is bit-preserving, so the bitcast
+    round-trips exactly).
+
+    ``v_block=None`` quantizes both per token row (the PR 6 wire codec).
+    ``v_block=B`` quantizes v per ``(B, d)`` slab and broadcasts each
+    block's scale to its token rows before the bitcast (k stays per-row)
+    — the payload is then bit-compatible with the row format
+    (:func:`unpack_kv` dequantizes it exactly) AND
+    :func:`payload_kernel_feed` can recover the :class:`QuantizedBlockKV`
+    the int8 flash kernels consume, with no dequant→requant round trip.
+    """
+    k_q, k_s = quantize_rows(k)
+    if v_block is None:
+        v_q, v_s = quantize_rows(v)
+    else:
+        v_q, v_s = quantize_blocks(v, v_block)
+        v_s = jnp.repeat(v_s, v_block, axis=-1)
+    vals = jnp.stack([k_q, v_q])  # (2, b, hk, n, d) int8
+    scales = jnp.stack([k_s, v_s])  # (2, b, hk, n) f32
+    scale_bytes = lax.bitcast_convert_type(scales, jnp.int8)  # (..., n, 4)
+    return jnp.concatenate([vals, scale_bytes], axis=-1)
+
+
+def unpack_kv(payload: jax.Array, dtype) -> tuple[jax.Array, jax.Array]:
+    """Materialize the ``(k, v)`` a packed payload represents (row- and
+    block-quantized payloads alike — block scales ride per-row)."""
+    d = payload.shape[-1] - SCALE_BYTES
+    vals = payload[..., :d].astype(jnp.float32)
+    scales = lax.bitcast_convert_type(
+        payload[..., d:], jnp.float32
+    )  # (2, b, hk, n)
+    kv = vals * scales[..., None]
+    return kv[0].astype(dtype), kv[1].astype(dtype)
+
+
+def payload_kernel_feed(
+    payload: jax.Array, v_block: int
+) -> QuantizedBlockKV | None:
+    """The dequant-free kernel feed of a ``pack_kv(v_block=...)`` payload.
+
+    Slices the int8 values, reads k's per-row scales straight off the
+    scale bytes, and recovers v's per-block scalars by sampling every
+    ``v_block``-th row (they are block-constant by construction, so the
+    sample is exact).  Valid only when ``v_block`` matches the
+    granularity the payload was packed at — the ring entry quantizes at
+    the kernel's fitted ``block_k`` precisely so this holds; returns None
+    when the token count does not divide (caller falls back to
+    :func:`unpack_kv`).
+    """
+    d = payload.shape[-1] - SCALE_BYTES
+    n = payload.shape[-2]
+    if n % v_block:
+        return None
+    vals = payload[..., :d]
+    scales = lax.bitcast_convert_type(payload[..., d:], jnp.float32)
+    return QuantizedBlockKV(
+        vals[0], scales[0], vals[1], scales[1][..., ::v_block], v_block
+    )

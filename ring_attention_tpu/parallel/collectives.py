@@ -142,25 +142,24 @@ def quantize_ring_payload(k: jax.Array, v: jax.Array) -> jax.Array:
     so the bitcast round-trips exactly), keeping the compressed variants'
     hop counts identical to the uncompressed contracts in
     ``analysis/contracts.py::CONTRACTS``.
-    """
-    from ..ops.pallas_flash import quantize_kv_cache
 
-    kv = quantize_kv_cache(k, v)
-    vals = jnp.stack([kv.k_q, kv.v_q])  # (2, b, hk, n, d) int8
-    scales = jnp.stack([kv.k_scale, kv.v_scale])  # (2, b, hk, n) f32
-    scale_bytes = lax.bitcast_convert_type(scales, jnp.int8)  # (..., n, 4)
-    return jnp.concatenate([vals, scale_bytes], axis=-1)
+    The codec itself lives in ``ops/quant.py`` (the one int8 seam, shared
+    with the decode cache and the int8 compute path); this wrapper is the
+    ring's named entry.  ``parallel/ring.py`` packs with
+    ``quant.pack_kv(v_block=...)`` instead when ``compute_dtype="int8"``
+    needs the dequant-free kernel feed — same wire format, kernel-ready
+    v scales.
+    """
+    from ..ops import quant
+
+    return quant.pack_kv(k, v)
 
 
 def dequantize_ring_payload(payload: jax.Array, dtype) -> tuple[jax.Array, jax.Array]:
     """Materialize the ``(k, v)`` a compressed hop payload represents."""
-    d = payload.shape[-1] - 4
-    vals = payload[..., :d].astype(jnp.float32)
-    scales = lax.bitcast_convert_type(
-        payload[..., d:], jnp.float32
-    )  # (2, b, hk, n)
-    kv = vals * scales[..., None]
-    return kv[0].astype(dtype), kv[1].astype(dtype)
+    from ..ops import quant
+
+    return quant.unpack_kv(payload, dtype)
 
 
 def fold_batch_into_seq(x: jax.Array, num_sharded_batches: int) -> jax.Array:

@@ -63,6 +63,7 @@ def hybrid_attention(
     segment_ids: jax.Array | None = None,
     counter_rotate: bool = False,
     hop_compression: str | None = None,
+    compute_dtype: str | None = None,
 ) -> jax.Array:
     """2-D factored sequence-parallel exact attention; call inside
     ``shard_map`` over a ``(data, ring, ulysses)`` mesh (``ulysses``
@@ -88,7 +89,8 @@ def hybrid_attention(
     (``ops/rotary.py::hybrid_positions`` computes them from the combined
     rank).  All remaining knobs (``window`` / ``max_ring_passes`` /
     ``bidirectional`` / ``dkv_dtype`` / ``counter_rotate`` /
-    ``hop_compression`` / ``impl``) pass straight through to the ring leg
+    ``hop_compression`` / ``compute_dtype`` / ``impl``) pass straight
+    through to the ring leg
     and mean what they mean there, with ``n_local`` read as the
     post-all-to-all chunk (``U x`` the resident shard) — in particular the
     TokenRing counter-rotation and int8 hop compression apply to the OUTER
@@ -136,7 +138,7 @@ def hybrid_attention(
             softclamp_value=softclamp_value, scale=scale, impl=impl,
             bidirectional=bidirectional, dkv_dtype=dkv_dtype,
             segment_ids=seg_c, counter_rotate=counter_rotate,
-            hop_compression=hop_compression,
+            hop_compression=hop_compression, compute_dtype=compute_dtype,
         )
 
     # head-sharded -> seq-sharded

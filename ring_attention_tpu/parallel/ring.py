@@ -87,6 +87,7 @@ from ..ops.pallas_flash import (
     pallas_flash_fused,
     pallas_flash_partials,
 )
+from ..ops import quant as _quant
 from .collectives import dequantize_ring_payload, quantize_ring_payload
 from ..utils.validate import check_attention_args
 
@@ -127,15 +128,23 @@ def _streams(bidirectional: bool, n_local: int) -> list[tuple[int, int, int]]:
     return [(1, 0, half), (-1, half, half)]
 
 
-def _kv_handle(k, v, hop_compression):
+def _kv_handle(k, v, hop_compression, q8_block=None):
     """Circulating KV payload: a stacked ``(2, b, hk, n, d)`` array in the
     model dtype, or — with ``hop_compression="int8"`` — a single
     ``(2, b, hk, n, d + 4)`` int8 array (values + bitcast f32 scale bytes)
     quantized ONCE here and circulated unchanged (hops are lossless moves;
     see ``collectives.quantize_ring_payload``).  Either way ONE array, so
-    every rotation is exactly one ``ppermute``."""
+    every rotation is exactly one ``ppermute``.
+
+    ``q8_block`` (set when ``compute_dtype="int8"`` rides the pallas
+    path) packs v's scales per KV-block of that size instead of per row —
+    bit-compatible on the wire and with :func:`_handle_kv`, but ALSO
+    directly consumable by the int8 kernels (``quant.payload_kernel_feed``)
+    with no dequant→requant round trip per hop."""
     if hop_compression is None:
         return jnp.stack([k, v])
+    if q8_block is not None:
+        return _quant.pack_kv(k, v, v_block=q8_block)
     return quantize_ring_payload(k, v)
 
 
@@ -144,6 +153,29 @@ def _handle_kv(handle, dtype):
     if handle.dtype == jnp.int8:
         return dequantize_ring_payload(handle, dtype)
     return handle[0], handle[1]
+
+
+def _handle_feed(handle, dtype, compute_dtype, q8_block):
+    """Kernel-feed view of a circulating handle: ``(k, v, kv_quantized)``.
+
+    The dequant-free composition seam: an int8-compressed hop payload
+    under ``compute_dtype="int8"`` feeds the kernel DIRECTLY — int8
+    values + per-row k scales + per-block v scales sliced straight out of
+    the payload (``quant.payload_kernel_feed``), no dequantize at the hop
+    and no re-quantize in the launcher.  The payload is quantized once at
+    ring entry; dequantization happens only inside the kernel's
+    accumulator rescale.  Every other combination degrades gracefully:
+    a compressed payload under bf16 compute dequantizes as before, an
+    uncompressed handle under int8 compute quantizes in the launcher
+    (its k/v are exact, so this is the FIRST quantization, not a re-).
+    """
+    if handle.dtype == jnp.int8:
+        if compute_dtype == "int8" and q8_block is not None:
+            feed = _quant.payload_kernel_feed(handle, q8_block)
+            if feed is not None:
+                return None, None, feed
+        return (*dequantize_ring_payload(handle, dtype), None)
+    return handle[0], handle[1], None
 
 
 def _handle_slice(handle, ofs, nk):
@@ -194,8 +226,16 @@ def _counter_origins(rank, i, ring_size):
     return (rank + nq) % ring_size, (rank - nk) % ring_size
 
 
+def _q8_block(bucket_size, nq, nk):
+    """The ``block_k`` a pallas launch over an ``(nq, nk)`` span will fit
+    — the granularity the int8 compute path's v scales must be packed at
+    for the dequant-free hop feed (one derivation shared by the payload
+    packer and the kernel's own ``_block_sizes`` fitting)."""
+    return _block_sizes(nq, nk, bucket_size, bucket_size)[1]
+
+
 def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask,
-                  segment_ids=None, hop_compression=None):
+                  segment_ids=None, hop_compression=None, q8_bucket=False):
     """Streams + their sliced KV handles, mask shards, and kv segment-id
     shards (fwd and bwd share this so the fallback condition and slice
     bounds can never diverge).  Segment ids circulate exactly like the
@@ -205,14 +245,31 @@ def _stream_state(bidirectional, passes, ring_size, n_local, k, v, kv_mask,
 
     With ``hop_compression``, the whole block is quantized once and the
     (half-)streams slice the shared int8 payload + scales, so
-    bidirectional halves ride one quantization pass.
+    bidirectional halves ride one quantization pass.  Under
+    ``compute_dtype="int8"`` (``q8_bucket`` set — the caller's
+    bucket_size) each stream instead packs its own span with v scales at
+    that span's fitted ``block_k``, so every hop's kernel can consume the
+    payload directly (:func:`_handle_feed`); still one quantization per
+    stream for the whole circulation.  ``q8_bucket=False`` (the default —
+    distinct from ``None``, a legal bucket_size) disables the feed
+    layout.
 
     Limited passes never see the reverse stream's useful origins in time
     (see the ``bidirectional`` docstring) — run unidirectional instead.
     """
     streams = _streams(bidirectional and passes == ring_size, n_local)
-    whole = _kv_handle(k, v, hop_compression)
-    kvs = tuple(_handle_slice(whole, ofs, nk) for (_, ofs, nk) in streams)
+    if hop_compression is not None and q8_bucket is not False:
+        kvs = tuple(
+            _kv_handle(
+                k[:, :, ofs:ofs + nk], v[:, :, ofs:ofs + nk],
+                hop_compression,
+                q8_block=_q8_block(q8_bucket, n_local, nk),
+            )
+            for (_, ofs, nk) in streams
+        )
+    else:
+        whole = _kv_handle(k, v, hop_compression)
+        kvs = tuple(_handle_slice(whole, ofs, nk) for (_, ofs, nk) in streams)
     masks = (
         tuple(kv_mask[:, ofs:ofs + nk] for (_, ofs, nk) in streams)
         if kv_mask is not None
@@ -445,7 +502,7 @@ def _span_bwd(impl, do, q, k, v, lse, delta, kv_mask, hi, lo, scale,
 def _ring_fwd_pallas(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     passes, window, softclamp_value, scale, bidirectional, ring_size, rank,
-    n_local, hop_compression=None,
+    n_local, hop_compression=None, compute_dtype=None,
 ):
     """Pallas ring forward: unrolled hops with in-kernel accumulator resume.
 
@@ -469,6 +526,7 @@ def _ring_fwd_pallas(
     streams, kvs, masks, segs = _stream_state(
         bidirectional, passes, ring_size, n_local, k, v, kv_mask, segment_ids,
         hop_compression,
+        q8_bucket=bucket_size if compute_dtype == "int8" else False,
     )
     n_spans = passes * len(streams)
     carry = None
@@ -494,25 +552,32 @@ def _ring_fwd_pallas(
             blk_q, blk_k = _pallas_blocks(
                 bucket_size, q.shape[2], stream[2]
             )
+            q8_blk = (_q8_block(bucket_size, q.shape[2], stream[2])
+                      if compute_dtype == "int8" else None)
             seg_pair = None if sx is None else (segment_ids, sx)
 
             def partials(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
-                         blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
-                kx, vx = _handle_kv(kvx, q.dtype)
+                         blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair,
+                         q8_blk=q8_blk):
+                kx, vx, kvq = _handle_feed(kvx, q.dtype, compute_dtype,
+                                           q8_blk)
                 return pallas_flash_partials(
                     q, kx, vx, mx,
                     scale=scale, causal_offset=hi, window_lo=lo,
                     softclamp_value=softclamp_value,
                     block_q=blk_q, block_k=blk_k,
                     band_hint=hint, carry=c, segment_ids=seg_pair,
+                    compute_dtype=compute_dtype, kv_quantized=kvq,
                 )
 
             with jax.named_scope(f"ring/hop{i}"):
                 if span == n_spans - 1:
 
                     def fuse(c, kvx=kvx, mx=mx, hi=hi, lo=lo, hint=hint,
-                             blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair):
-                        kx, vx = _handle_kv(kvx, q.dtype)
+                             blk_q=blk_q, blk_k=blk_k, seg_pair=seg_pair,
+                             q8_blk=q8_blk):
+                        kx, vx, kvq = _handle_feed(kvx, q.dtype,
+                                                   compute_dtype, q8_blk)
                         return pallas_flash_fused(
                             q, kx, vx, mx,
                             scale=scale, causal_offset=hi, window_lo=lo,
@@ -523,6 +588,7 @@ def _ring_fwd_pallas(
                             # row's carry holds its own-diagonal content
                             band_hint=hint if c is not None else None,
                             carry=c, segment_ids=seg_pair,
+                            compute_dtype=compute_dtype, kv_quantized=kvq,
                         )
 
                     if carry is None:  # ring of one: plain fused local sweep
@@ -569,7 +635,7 @@ def _counter_static_band(i, n_local, causal, striped, window, ring_size):
 def _counter_fwd(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     passes, window, softclamp_value, scale, impl, ring_size, rank, n_local,
-    hop_compression,
+    hop_compression, compute_dtype=None,
 ):
     """TokenRing counter-rotation forward (arXiv 2412.20501).
 
@@ -607,7 +673,16 @@ def _counter_fwd(
     b, h, n, d = q.shape
     hk = k.shape[1]
     g = h // hk
-    kvh = _kv_handle(k, v, hop_compression)
+    # compute_dtype="int8" on the pallas path: pack the circulating KV
+    # with v scales at the kernel's fitted block so every hop feeds the
+    # int8 kernel DIRECTLY (quantize once at ring entry, dequantize only
+    # in the accumulator rescale — no per-hop dequant→requant round trip)
+    q8_blk = (_q8_block(bucket_size, n, n)
+              if compute_dtype == "int8" and impl == "pallas" else None)
+    kvh = _kv_handle(
+        k, v, hop_compression,
+        q8_block=q8_blk if hop_compression is not None else None,
+    )
     mask, q_seg, kv_seg = kv_mask, segment_ids, segment_ids
 
     def span(i, qx, acc, m, l, kvh, mask, q_seg, kv_seg):
@@ -630,8 +705,9 @@ def _counter_fwd(
 
         def compute(args):
             acc, m, l = args
-            kx, vx = _handle_kv(kvh, q.dtype)
             if impl == "pallas":
+                kx, vx, kvq = _handle_feed(kvh, q.dtype, compute_dtype,
+                                           q8_blk)
                 blk_q, blk_k = _pallas_blocks(bucket_size, n, n)
                 p = pallas_flash_partials(
                     qx, kx, vx, mask,
@@ -640,8 +716,10 @@ def _counter_fwd(
                     block_q=blk_q, block_k=blk_k, band_hint=hint,
                     carry=None if acc is None else FlashPartials(acc, m, l),
                     segment_ids=seg_pair,
+                    compute_dtype=compute_dtype, kv_quantized=kvq,
                 )
                 return p.acc, p.m, p.l
+            kx, vx = _handle_kv(kvh, q.dtype)
             carry = FlashCarry(
                 acc.reshape(b, hk, g, n, d),
                 m.reshape(b, hk, g, n),
@@ -880,6 +958,7 @@ def ring_flash_attention(
     segment_ids: jax.Array | None = None,
     counter_rotate: bool = False,
     hop_compression: str | None = None,
+    compute_dtype: str | None = None,
 ) -> jax.Array:
     """Sequence-parallel exact attention; call inside ``shard_map``.
 
@@ -948,6 +1027,15 @@ def ring_flash_attention(
         at ring entry (hops are lossless moves); the backward recomputes
         from the exact residual ``(k, v)``, and every ``(acc, m, l)`` /
         dk/dv accumulator stays f32 (``audit_accumulator_dtypes``).
+      compute_dtype: ``"int8"`` runs the forward's QK^T and PV matmuls on
+        int8 operands (pallas path only — q per-row, k per-row, v
+        per-KV-block absmax scales; f32 ``(acc, m, l)`` untouched;
+        ``docs/precision.md``).  Composes with ``hop_compression="int8"``
+        into the dequant-free ring: the hop payload is packed with
+        kernel-ready scales at ring entry and feeds every hop's kernel
+        DIRECTLY — one quantization per payload for the whole
+        circulation, no per-hop dequant→requant.  The backward stays bf16
+        from the exact residuals this round.
 
     Cross-attention (unequal q/kv shard lengths) silently bypasses the ring
     and runs local flash over the local KV shard — the reference degrades
@@ -966,6 +1054,16 @@ def ring_flash_attention(
             f"hop_compression={hop_compression!r}: supported values are "
             'None (model-dtype hops) and "int8" (per-token absmax '
             "quantized hops)"
+        )
+    if compute_dtype not in (None, "int8"):
+        raise ValueError(
+            f"compute_dtype={compute_dtype!r}: supported values are None "
+            '(model-dtype matmuls) and "int8" (quantized QK^T/PV)'
+        )
+    if compute_dtype == "int8" and impl != "pallas":
+        raise ValueError(
+            'compute_dtype="int8" runs on the Pallas kernels only — pass '
+            'impl="pallas" (the XLA flash path has no int8 matmul form)'
         )
     if counter_rotate and bidirectional:
         # a KV half-stream co-moving with the Q stream never advances its
@@ -996,6 +1094,7 @@ def ring_flash_attention(
             return pallas_flash_attention(
                 q, k, v, kv_mask, causal=causal, window=window,
                 softclamp_value=softclamp_value, scale=scale,
+                compute_dtype=compute_dtype,
             )
         return flash_attention(
             q, k, v, kv_mask, causal=causal, bucket_size=bucket_size,
@@ -1005,23 +1104,25 @@ def ring_flash_attention(
         q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
         bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
         bidirectional, dkv_dtype, counter_rotate, hop_compression,
+        compute_dtype,
     )
 
 
 @partial(
     jax.custom_vjp,
-    nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17),
+    nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18),
 )
 def _ring_flash_attention_core(
     q, k, v, kv_mask, segment_ids, axis_name, causal=False, striped=False,
     bucket_size=None, max_ring_passes=None, window=None,
     softclamp_value=None, scale=None, impl="xla", bidirectional=False,
     dkv_dtype=None, counter_rotate=False, hop_compression=None,
+    compute_dtype=None,
 ):
     out, _ = _ring_fwd_impl(
         q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
         bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
-        bidirectional, counter_rotate, hop_compression,
+        bidirectional, counter_rotate, hop_compression, compute_dtype,
     )
     return out
 
@@ -1029,7 +1130,7 @@ def _ring_flash_attention_core(
 def _ring_fwd_impl(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
-    counter_rotate=False, hop_compression=None,
+    counter_rotate=False, hop_compression=None, compute_dtype=None,
 ):
     if window is not None:
         assert causal, "lookback windows require causal attention"
@@ -1045,7 +1146,7 @@ def _ring_fwd_impl(
         out, lse = _counter_fwd(
             q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
             bucket_size, passes, window, softclamp_value, scale, impl,
-            ring_size, rank, n_local, hop_compression,
+            ring_size, rank, n_local, hop_compression, compute_dtype,
         )
         out = checkpoint_name(out, "flash_out")
         lse = checkpoint_name(lse, "flash_lse")
@@ -1056,6 +1157,7 @@ def _ring_fwd_impl(
             q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
             bucket_size, passes, window, softclamp_value, scale,
             bidirectional, ring_size, rank, n_local, hop_compression,
+            compute_dtype,
         )
         out = checkpoint_name(out, "flash_out")
         lse = checkpoint_name(lse, "flash_lse")
@@ -1121,12 +1223,12 @@ def _ring_fwd_impl(
 def _ring_vjp_fwd(
     q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
     max_ring_passes, window, softclamp_value, scale, impl, bidirectional,
-    dkv_dtype, counter_rotate, hop_compression,
+    dkv_dtype, counter_rotate, hop_compression, compute_dtype=None,
 ):
     out, lse = _ring_fwd_impl(
         q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
         bucket_size, max_ring_passes, window, softclamp_value, scale, impl,
-        bidirectional, counter_rotate, hop_compression,
+        bidirectional, counter_rotate, hop_compression, compute_dtype,
     )
     return out, (q, k, v, kv_mask, segment_ids, out, lse)
 
@@ -1134,8 +1236,11 @@ def _ring_vjp_fwd(
 def _ring_vjp_bwd(
     axis_name, causal, striped, bucket_size, max_ring_passes, window,
     softclamp_value, scale, impl, bidirectional, dkv_dtype, counter_rotate,
-    hop_compression, res, do,
+    hop_compression, compute_dtype, res, do,
 ):
+    # the backward ignores compute_dtype this round: grads recompute
+    # scores in bf16 from the EXACT residual (q, k, v) — only the
+    # forward's (out, lse) carry int8 error (docs/precision.md §5)
     q, k, v, kv_mask, segment_ids, out, lse = res
     b, h, n_local, d = q.shape
     hk = k.shape[1]

@@ -188,7 +188,7 @@ def _varint(buf: bytes, i: int) -> tuple[int, int]:
     while True:
         b = buf[i]
         i += 1
-        r |= (b & 0x7F) << s
+        r |= (b & 0x7F) << s  # ra: allow(RA012 protobuf varint 7-bit payload mask, not quantization)
         if not b & 0x80:
             return r, i
         s += 7

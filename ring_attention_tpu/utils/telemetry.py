@@ -803,6 +803,7 @@ def train_memory_estimate(
     remat_policy: str | None = None,
     offload_opt_state: bool = False,
     seq_shards: int = 1,
+    compute_dtype: str | None = None,
 ) -> dict[str, Any]:
     """Analytic per-chip peak-HBM model of one rematted train step.
 
@@ -845,12 +846,24 @@ def train_memory_estimate(
     ) * 2  # forward value + its cotangent live together in backward
 
     total = params_bytes + opt_bytes + saved + transient
+    # the attention matmul feed (per layer, transient): one q/k/v copy at
+    # the compute operand width — int8 quarters/halves these (PR 13,
+    # docs/precision.md) while the f32 online-softmax accumulator state
+    # is INVARIANT (the contract the precision auditor proves); reported
+    # as dedicated keys, not folded into the peak (the FFN/CE transients
+    # above dominate it at every modeled shape)
+    operand_bytes = 1 if compute_dtype == "int8" else act
+    attn_operand_bytes = 3 * b * n * dim * operand_bytes
+    attn_accumulator_bytes = b * n * (dim + 2 * heads) * 4
     return {
         "peak_hbm_bytes": int(total),
         "peak_hbm_gb": round(total / 2**30, 3),
         "params_bytes": int(params_bytes + opt_bytes),
         "saved_activation_bytes": int(saved),
         "transient_bytes": int(transient),
+        "compute_dtype": compute_dtype,
+        "attn_operand_bytes": int(attn_operand_bytes),
+        "attn_accumulator_bytes": int(attn_accumulator_bytes),
     }
 
 
@@ -871,6 +884,7 @@ def ring_comms_accounting(
     ici_gbps: float | None = None,
     counter_rotate: bool = False,
     hop_compression: str | None = None,
+    compute_dtype: str | None = None,
 ) -> dict[str, Any]:
     """Topology-aware per-step communication accounting for a
     (ring x ulysses) sequence-parallel factoring (TASP, arXiv 2509.26541).
@@ -914,12 +928,28 @@ def ring_comms_accounting(
     - ``fwd_link_direction_bytes`` — the busier ICI direction's forward
       rotation traffic per device: the counter schedule splits the
       payloads across both full-duplex directions, the baseline loads one.
+
+    ``compute_dtype="int8"`` (PR 13, the quantized QK^T/PV kernel path,
+    ``docs/precision.md``) accounts the matmul FEED rather than the wire:
+    ``matmul_operand_bytes`` — the q/k/v operand bytes one hop's kernels
+    read, at 1 byte/element instead of ``dtype_bytes`` — and the per-hop
+    compute time in the overlap model runs at the int8 MXU rate (~2x the
+    bf16 peak on v5e/v5p), so ``hop_overlap_fraction`` reflects that a
+    quantized hop has HALF the compute available to hide the same
+    transfer.  ``accumulator_bytes`` — the f32 ``(acc, m, l)`` state —
+    is emitted under every compute_dtype and is invariant by
+    construction: the contract the precision auditor proves.
     """
     if heads is None:
         heads = kv_heads
     if hop_compression not in (None, "int8"):
         raise ValueError(
             f"ring_comms_accounting: hop_compression={hop_compression!r}; "
+            'want None or "int8" (parallel/ring.py accepts the same values)'
+        )
+    if compute_dtype not in (None, "int8"):
+        raise ValueError(
+            f"ring_comms_accounting: compute_dtype={compute_dtype!r}; "
             'want None or "int8" (parallel/ring.py accepts the same values)'
         )
     world = ring_size * ulysses_size
@@ -1009,16 +1039,32 @@ def ring_comms_accounting(
             ici_gbps = device_ici_gbps()
         except Exception:  # noqa: BLE001
             ici_gbps = ICI_GBPS["v5e"]
-    compute_s = hop_flops / (peak_tflops * 1e12)
+    # int8 matmuls run at ~2x the bf16 MXU rate (v5e/v5p), so a quantized
+    # hop finishes its compute in half the time — less of it available to
+    # hide the same ICI transfer
+    matmul_peak = peak_tflops * (2.0 if compute_dtype == "int8" else 1.0)
+    compute_s = hop_flops / (matmul_peak * 1e12)
     # the counter schedule's worst rotation is whichever circulating
     # payload is larger (Q-pack vs KV handle); baseline it's the KV hop
     transfer_s = worst_hop_bytes / (ici_gbps * 1e9)
     overlap = compute_s / max(compute_s, transfer_s, 1e-30)
+    # the matmul feed (per hop per device): q read once + the held k/v
+    # span, at the compute operand width; the f32 (acc, m, l) state is
+    # the invariant the precision auditor pins — never quantized
+    operand_bytes = 1 if compute_dtype == "int8" else dtype_bytes
+    matmul_operand_bytes = (
+        batch * heads_local * n_chunk * dim_head
+        + 2 * batch * kv_heads_local * n_chunk * dim_head
+    ) * operand_bytes
+    accumulator_bytes = 4 * batch * heads_local * n_chunk * (dim_head + 2)
     return {
         "ring_size": ring_size,
         "ulysses_size": ulysses_size,
         "counter_rotate": counter_rotate,
         "hop_compression": hop_compression,
+        "compute_dtype": compute_dtype,
+        "matmul_operand_bytes": matmul_operand_bytes,
+        "accumulator_bytes": accumulator_bytes,
         "ring_hops": hops,
         "pure_ring_hops": pure_ring_hops,
         "ring_hops_per_step": hops * depth * 2,  # fwd + bwd rings

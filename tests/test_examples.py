@@ -69,6 +69,25 @@ def test_train_example_hybrid():
 
 
 @pytest.mark.slow
+def test_train_example_int8_compute():
+    """--compute-dtype int8 trains end-to-end on the pallas ring (PR 13):
+    quantized forward matmuls + dequant-free int8 hops, bf16 backward
+    from exact residuals — losses stay finite and non-exploding."""
+    out = _run_example(
+        "train.py", "--fake-devices", "8", "--steps", "3",
+        "--seq-len", "64", "--dim", "32", "--batch", "2",
+        "--use-pallas", "--counter-rotate",
+        "--hop-compression", "int8", "--compute-dtype", "int8",
+    )
+    losses = [
+        float(line.split("loss")[1].split()[0])
+        for line in out.splitlines() if "loss" in line
+    ]
+    assert losses and all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0] * 1.05, f"loss exploded: {losses}"
+
+
+@pytest.mark.slow
 def test_train_example_accum_remat_chunked_ce():
     out = _run_example(
         "train.py", "--fake-devices", "8", "--steps", "2",

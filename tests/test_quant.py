@@ -1,0 +1,501 @@
+"""The int8 seam (``ops/quant.py``) and the int8 COMPUTE path (PR 13).
+
+Three layers of coverage:
+
+1. **Codec units** — row/block absmax roundtrips with per-element error
+   bounds, the single-array hop payload's bit-compatibility across scale
+   granularities, slice-scale properties (slicing a payload at block
+   boundaries commutes with extracting kernel scales), and the dedupe pin
+   that ``quantize_ring_payload`` IS ``quant.pack_kv``.
+
+2. **Kernel + ring parity fuzz** — int8 QK^T/PV vs the bf16 kernels on
+   plain/striped/counter/windowed/packed configs (CPU interpret mode),
+   with pinned tolerances.  The int8 COMPUTE path quantizes BOTH matmul
+   feeds (q, k, p, v) where PR 6's hop compression quantized only the
+   wire (k, v), so its worst-case elementwise bound is wider than the
+   hop bound (2.5e-2): error concentrates on rows with two near-tied
+   sharp softmax weights (logit noise × weight gap — docs/precision.md
+   §4), while the bulk of the distribution stays at bf16-noise level.
+   Both pins below (max-abs AND relative L2) regress loudly if a second
+   quantization or a broken scale creeps in.
+
+3. **Composition proofs** — the dequant-free ring feed is BIT-IDENTICAL
+   to launcher-side quantization (same codec, same granularity), the
+   requant pin counts exactly one quantization per payload per
+   circulation from the jaxpr, and the precision auditor's negative toy
+   (a dropped dequant) fails one-line.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ring_attention_tpu.ops import quant
+from ring_attention_tpu.ops.pallas_flash import (
+    finalize_partials,
+    pallas_flash_attention,
+    pallas_flash_backward,
+    pallas_flash_partials,
+)
+from ring_attention_tpu.parallel.collectives import (
+    dequantize_ring_payload,
+    quantize_ring_payload,
+)
+from ring_attention_tpu.parallel.mesh import create_mesh
+from ring_attention_tpu.parallel.ring import ring_flash_attention
+from ring_attention_tpu.utils.compat import shard_map
+
+# Pinned int8-COMPUTE parity bounds on unit-variance inputs (measured
+# worst ~9.5e-2 max-abs / ~1.4e-2 rel-L2 across seeds and configs under
+# the suite's highest-precision matmuls; see the module docstring for
+# why the elementwise tail is wider than PR 6's wire-only 2.5e-2 — the
+# relative-L2 pin is the tight regression signal, the max-abs pin the
+# tail rail).
+Q8_FWD_MAX_ABS = 0.12
+Q8_FWD_REL_L2 = 2e-2
+Q8_GRAD_REL_L2 = 3e-2
+Q8_GRAD_MAX_ABS = 0.2
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # ring 2 keeps the unrolled-pallas compile count down (tier-1 is
+    # compile-dominated) while still exercising rotation, in-kernel
+    # carry resume, the dequant-free hop feed, and the counter catch-up;
+    # the slow-tier sweep and the PR 6 hop tests cover larger rings
+    return create_mesh(ring_size=2, data_size=4)
+
+
+def make_qkv(rng, b=4, h=4, hk=None, n=64, d=16):
+    hk = hk or h
+    q = jnp.asarray(rng.standard_normal((b, h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.float32)
+    return q, k, v
+
+
+# ----------------------------------------------------------------------
+# 1. codec units
+# ----------------------------------------------------------------------
+
+
+def test_rows_roundtrip(rng):
+    x = jnp.asarray(rng.standard_normal((2, 3, 32, 8)), jnp.float32)
+    xq, s = quant.quantize_rows(x)
+    assert xq.dtype == jnp.int8 and s.shape == (2, 3, 32)
+    back = quant.dequantize_rows(xq, s, jnp.float32)
+    # per element: half an LSB of that row's scale (a hair of float
+    # slack: the scale itself is rounded, so exact half-LSB ties land
+    # epsilon past 0.5 * s)
+    bound = np.asarray(s)[..., None] * 0.505 + 1e-7
+    np.testing.assert_array_less(
+        np.abs(np.asarray(back - x)), np.broadcast_to(bound, x.shape))
+    # all-zero rows: zero values under the RAW (zero) scale — the PR 6
+    # wire convention — so dequantization is exactly 0.0, never NaN
+    zq, zs = quant.quantize_rows(jnp.zeros((1, 4, 8)))
+    assert float(jnp.abs(zq).max()) == 0 and float(zs.max()) == 0.0
+    assert float(jnp.abs(
+        quant.dequantize_rows(zq, zs, jnp.float32)).max()) == 0.0
+
+
+def test_blocks_roundtrip(rng):
+    x = jnp.asarray(rng.standard_normal((2, 32, 8)), jnp.float32)
+    xq, s = quant.quantize_blocks(x, 8)
+    assert s.shape == (2, 4)
+    back = quant.dequantize_blocks(xq, s, 8, jnp.float32)
+    bound = np.repeat(np.asarray(s), 8, axis=-1)[..., None] * 0.505 + 1e-7
+    np.testing.assert_array_less(
+        np.abs(np.asarray(back - x)), np.broadcast_to(bound, x.shape))
+    with pytest.raises(ValueError, match="divide"):
+        quant.quantize_blocks(x, 7)
+
+
+def test_quantize_p(rng):
+    p = jnp.asarray(rng.uniform(0, 1, (16, 32)), jnp.float32)
+    p = p.at[3].set(0.0)  # a fully-masked row
+    p8, s = quant.quantize_p(p)
+    assert p8.dtype == jnp.int8 and s.shape == (16, 1)
+    back = np.asarray(p8, np.float32) * np.asarray(s)
+    bound = np.maximum(np.asarray(p).max(-1, keepdims=True), 1.0) / 254 * 1.02 + 1e-7
+    np.testing.assert_array_less(
+        np.abs(back - np.asarray(p)), np.broadcast_to(bound, p.shape))
+    assert float(jnp.abs(p8[3]).max()) == 0  # zero row quantizes to zeros
+
+
+def test_pack_kv_is_the_ring_codec(rng):
+    """Dedupe pin: the PR 6 wire codec IS quant.pack_kv — bit-for-bit."""
+    k = jnp.asarray(rng.standard_normal((2, 2, 16, 8)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((2, 2, 16, 8)), jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(quantize_ring_payload(k, v)),
+        np.asarray(quant.pack_kv(k, v)),
+    )
+
+
+def test_pack_kv_block_payload_row_compatible(rng):
+    """A v_block payload is a VALID row payload: unpack_kv dequantizes it
+    exactly (block scales ride per-row), so _handle_kv / backward-side
+    consumers never need to know the granularity."""
+    k = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+    payload = quant.pack_kv(k, v, v_block=8)
+    k2, v2 = quant.unpack_kv(payload, jnp.float32)
+    feed = quant.payload_kernel_feed(payload, 8)
+    np.testing.assert_allclose(
+        np.asarray(v2),
+        np.asarray(quant.dequantize_blocks(feed.v_q, feed.v_scale, 8,
+                                           jnp.float32)),
+        rtol=0, atol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(k2),
+        np.asarray(quant.dequantize_rows(feed.k_q, feed.k_scale,
+                                         jnp.float32)),
+        rtol=0, atol=0,
+    )
+    # row-packed payloads dequantize identically through both codecs too
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_ring_payload(quant.pack_kv(k, v), jnp.float32)[0]),
+        np.asarray(quant.unpack_kv(quant.pack_kv(k, v), jnp.float32)[0]),
+    )
+
+
+def test_payload_slice_scale_property(rng):
+    """Slicing a block payload at block boundaries commutes with the
+    kernel feed: feed(payload[ofs:ofs+span]) == slice(feed(payload)) —
+    the property the ring's per-hop span slicing relies on."""
+    k = jnp.asarray(rng.standard_normal((1, 2, 64, 8)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 2, 64, 8)), jnp.bfloat16)
+    payload = quant.pack_kv(k, v, v_block=16)
+    whole = quant.payload_kernel_feed(payload, 16)
+    part = quant.payload_kernel_feed(payload[:, :, :, 16:48], 16)
+    np.testing.assert_array_equal(np.asarray(part.k_q),
+                                  np.asarray(whole.k_q[:, :, 16:48]))
+    np.testing.assert_array_equal(np.asarray(part.k_scale),
+                                  np.asarray(whole.k_scale[:, :, 16:48]))
+    np.testing.assert_array_equal(np.asarray(part.v_scale),
+                                  np.asarray(whole.v_scale[:, :, 1:3]))
+    # non-dividing span: no feed (caller falls back to unpack_kv)
+    assert quant.payload_kernel_feed(payload[:, :, :, :24], 16) is None
+
+
+# ----------------------------------------------------------------------
+# 2. kernel + ring parity fuzz
+# ----------------------------------------------------------------------
+
+
+def _assert_q8_close(got, ref, tag):
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    worst = float(np.abs(got - ref).max())
+    assert worst <= Q8_FWD_MAX_ABS, f"{tag}: max abs {worst:.4f}"
+    rel = float(np.linalg.norm(got - ref) / np.linalg.norm(ref))
+    assert rel <= Q8_FWD_REL_L2, f"{tag}: rel L2 {rel:.4f}"
+
+
+def test_kernel_q8_parity(rng):
+    """Local kernel path: int8 vs bf16 fused forward — plain causal,
+    windowed, and packed-segment configs, plus the resumed-carry
+    partials form (the ring-hop kernel)."""
+    q, k, v = make_qkv(rng)
+    for tag, kw in (
+        ("causal", dict(causal=True)),
+        ("window", dict(causal=True, window=48)),
+    ):
+        ref = pallas_flash_attention(q, k, v, **kw)
+        got = pallas_flash_attention(q, k, v, compute_dtype="int8", **kw)
+        _assert_q8_close(got, ref, tag)
+
+    n = q.shape[2]
+    ids = np.repeat(np.arange(4, dtype=np.int32), n // 4)
+    seg = jnp.asarray(np.broadcast_to(ids, (q.shape[0], n)).copy())
+    ref = pallas_flash_attention(q, k, v, causal=True, segment_ids=seg)
+    got = pallas_flash_attention(q, k, v, causal=True, segment_ids=seg,
+                                 compute_dtype="int8")
+    _assert_q8_close(got, ref, "packed")
+
+    # resumed carry across two spans (flash_partials_tile_resume_q8)
+    scale = q.shape[-1] ** -0.5
+    def two_span(cd):
+        p = pallas_flash_partials(q, k, v, scale=scale, causal_offset=0,
+                                  block_q=32, block_k=32, compute_dtype=cd)
+        p = pallas_flash_partials(q, k, v, scale=scale, block_q=32,
+                                  block_k=32, carry=p, compute_dtype=cd)
+        return finalize_partials(p)[0]
+    _assert_q8_close(two_span("int8"), two_span(None), "resume")
+
+
+def _ring_fns(mesh, **kw):
+    def build(cd):
+        def fn(q, k, v):
+            return ring_flash_attention(
+                q, k, v, None, "seq", causal=True, bucket_size=16,
+                impl="pallas", compute_dtype=cd, **kw,
+            )
+        qspec = P("data", None, "seq", None)
+        return shard_map(fn, mesh=mesh, in_specs=(qspec,) * 3,
+                         out_specs=qspec, check_vma=False)
+    return build(None), build("int8")
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [{}, {"striped": True}, {"counter_rotate": True},
+     {"counter_rotate": True, "hop_compression": "int8"},
+     {"window": 48}],
+    ids=["plain", "striped", "counter", "counter_hop8", "windowed"],
+)
+def test_ring_q8_parity(rng, mesh, kw):
+    """Ring path: int8 compute vs bf16 compute per strategy config (the
+    counter_hop8 row exercises the dequant-free payload feed)."""
+    ref_fn, q8_fn = _ring_fns(mesh, **kw)
+    q, k, v = make_qkv(rng)
+    _assert_q8_close(q8_fn(q, k, v), ref_fn(q, k, v), str(kw))
+
+
+def test_ring_q8_packed_segments(rng, mesh):
+    """Packed segment ids compose with int8 compute (ids rotate
+    uncompressed; cross-document pairs masked after dequant)."""
+    q, k, v = make_qkv(rng)
+    n = q.shape[2]
+    ids = np.zeros(n, np.int32)
+    ids[n // 2:] = 1
+    seg = jnp.asarray(np.broadcast_to(ids, (q.shape[0], n)).copy())
+
+    def run(cd):
+        fn = partial(ring_flash_attention, axis_name="seq", causal=True,
+                     bucket_size=16, impl="pallas", compute_dtype=cd)
+        qspec = P("data", None, "seq", None)
+        return shard_map(
+            lambda q, k, v, s: fn(q, k, v, None, segment_ids=s),
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec, P("data", "seq")),
+            out_specs=qspec, check_vma=False,
+        )(q, k, v, seg)
+
+    _assert_q8_close(run("int8"), run(None), "packed")
+
+
+def test_ring_q8_grads_close(rng, mesh):
+    """Grads of the int8-forward ring vs the bf16 ring: the backward is
+    bf16 from exact residuals, so grad error is the forward's (out, lse)
+    error propagated through the loss — bounded, and the f32 accumulator
+    contract is machine-checked right here."""
+    ref_fn, q8_fn = _ring_fns(mesh, counter_rotate=True,
+                              hop_compression="int8")
+    q, k, v = make_qkv(rng)
+    ge = jax.grad(lambda *a: (ref_fn(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    gc = jax.grad(lambda *a: (q8_fn(*a) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(gc, ge, "qkv"):
+        rel = float(np.linalg.norm(a - b) / np.linalg.norm(b))
+        assert rel <= Q8_GRAD_REL_L2, f"d{name}: rel L2 {rel:.4f}"
+        worst = float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        assert worst <= Q8_GRAD_MAX_ABS, f"d{name}: max abs {worst:.4f}"
+
+    from ring_attention_tpu.analysis.recompile import audit_accumulator_dtypes
+
+    assert audit_accumulator_dtypes() == []
+
+
+@pytest.mark.slow
+def test_contract_counter_q8(devices):
+    """The counter_q8 contract row: identical collective schedule to
+    counter_compressed (quantized matmuls change the kernel FEED, never
+    the ring's collectives) — exact HLO hop counts fwd+fwdbwd, permute
+    pairs both directions, hop-bytes pin.  Slow tier like the compressed
+    rows' fwdbwd; `check_contracts.py --strategy all`, the analysis
+    self-run, and the committed fingerprint baseline also hold it."""
+    from ring_attention_tpu.analysis import contracts
+
+    reports = contracts.check_strategy("counter_q8")
+    bad = [v for r in reports for v in r.violations]
+    assert not bad, "\n".join(bad)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("counter", [False, True], ids=["uni", "counter"])
+@pytest.mark.parametrize("hk", [4, 2], ids=["mha", "gqa"])
+def test_ring_q8_parity_exhaustive(mesh, counter, hk):
+    """Full {uni,counter} x {mha,gqa} sweep, fwd at 3 seeds + grads."""
+    ref_fn, q8_fn = _ring_fns(mesh, counter_rotate=counter,
+                              hop_compression="int8")
+    ge = jax.grad(lambda *a: (ref_fn(*a) ** 2).sum(), (0, 1, 2))
+    gc = jax.grad(lambda *a: (q8_fn(*a) ** 2).sum(), (0, 1, 2))
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        q, k, v = make_qkv(rng, hk=hk)
+        _assert_q8_close(q8_fn(q, k, v), ref_fn(q, k, v), f"seed={seed}")
+        for a, b, name in zip(gc(q, k, v), ge(q, k, v), "qkv"):
+            rel = float(np.linalg.norm(a - b) / np.linalg.norm(b))
+            assert rel <= Q8_GRAD_REL_L2, f"d{name} seed={seed}: {rel:.4f}"
+
+
+# ----------------------------------------------------------------------
+# 3. composition proofs
+# ----------------------------------------------------------------------
+
+
+def test_direct_feed_bitexact_vs_launcher_quant(rng):
+    """The dequant-free hop feed (payload -> payload_kernel_feed ->
+    kernel) is BIT-IDENTICAL to handing the kernel the dequantized k/v
+    and letting the launcher quantize — same codec, same granularity; a
+    drift here means the two quantization paths forked."""
+    q, k, v = make_qkv(rng, b=1, h=2, hk=2, n=64, d=8)
+    q = q.astype(jnp.bfloat16)
+    scale = q.shape[-1] ** -0.5
+    payload = quant.pack_kv(k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+                            v_block=16)
+    feed = quant.payload_kernel_feed(payload, 16)
+    direct = pallas_flash_partials(
+        q, None, None, scale=scale, causal_offset=0, compute_dtype="int8",
+        kv_quantized=feed, block_q=16, block_k=16,
+    )
+    kd, vd = quant.unpack_kv(payload, jnp.bfloat16)
+    requant = pallas_flash_partials(
+        q, kd, vd, scale=scale, causal_offset=0, compute_dtype="int8",
+        block_q=16, block_k=16,
+    )
+    np.testing.assert_array_equal(np.asarray(direct.acc),
+                                  np.asarray(requant.acc))
+    np.testing.assert_array_equal(np.asarray(direct.l),
+                                  np.asarray(requant.l))
+
+
+def test_requant_pin_one_quantize_per_payload(mesh):
+    """Jaxpr pin (acceptance): the counter-rotated int8 ring with int8
+    compute quantizes each KV payload exactly ONCE at ring entry (2
+    float->int8 casts: k and v) plus one q cast per hop's launcher —
+    ``2 + passes`` total outside the kernel bodies.  The naive
+    dequant->requant composition re-casts k AND v at every hop
+    (``3 * passes``); both counts are pinned so either regression
+    (a new requant, or a silently-dropped q quantization) fails."""
+    from ring_attention_tpu.analysis.dataflow import count_int8_quantize_ops
+
+    ring = mesh.shape["seq"]
+    q = jnp.zeros((4, 4, 32 * ring, 16), jnp.float32)
+    qspec = P("data", None, "seq", None)
+
+    def traced(**kw):
+        fn = shard_map(
+            lambda q, k, v: ring_flash_attention(
+                q, k, v, None, "seq", causal=True, bucket_size=16,
+                impl="pallas", compute_dtype="int8", **kw,
+            ),
+            mesh=mesh, in_specs=(qspec,) * 3, out_specs=qspec,
+            check_vma=False,
+        )
+        return jax.make_jaxpr(fn)(q, q, q)
+
+    assert count_int8_quantize_ops(
+        traced(counter_rotate=True, hop_compression="int8")
+    ) == 2 + ring
+    # the contrast: no wire compression -> the launcher's k/v casts run
+    # per hop (each hop's kv is exact bf16 — first quantization, not a
+    # re-quantization; still 3 casts per hop vs the packed path's 1)
+    assert count_int8_quantize_ops(traced(counter_rotate=True)) == 3 * ring
+
+
+def test_dropped_dequant_toy_fails_one_line():
+    """Negative toy (acceptance): an int8 x int8 QK^T whose output skips
+    the scale multiply is flagged by the precision auditor in one line
+    naming the rule; the scaled form is clean."""
+    from jax import lax
+
+    from ring_attention_tpu.analysis import dataflow
+
+    q8 = jnp.ones((8, 8), jnp.int8)
+
+    def dropped(q8, k8):
+        s = lax.dot_general(q8, k8, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        return jnp.exp(s - jnp.max(s, axis=1, keepdims=True)).sum()
+
+    violations = dataflow.audit_precision_flow(dropped, q8, q8, label="toy")
+    assert violations and all("\n" not in f for f in violations)
+    assert any("[rule: int8-dequant]" in f for f in violations)
+
+    def scaled(q8, k8, sc):
+        s = lax.dot_general(q8, k8, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sc
+        return jnp.exp(s - jnp.max(s, axis=1, keepdims=True)).sum()
+
+    assert dataflow.audit_precision_flow(
+        scaled, q8, q8, jnp.float32(0.1), label="toy") == []
+
+
+def test_precision_auditor_covers_q8_kernels(rng):
+    """Acceptance: the precision-flow auditor passes on the int8 kernel
+    jaxprs (fwd int8 + bwd bf16, and the dequant-free feed chain) — no
+    reduction/exp/loop-carry sees undequantized int8, f32 (acc, m, l)
+    pinned.  Audits the two PR 13 chains directly (the full suite —
+    which includes the same rows — rides ``check_contracts.py
+    --dataflow`` and the analysis self-run)."""
+    from ring_attention_tpu.analysis.dataflow import audit_precision_flow
+
+    q = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+    kv = jnp.asarray(rng.standard_normal((1, 1, 32, 8)), jnp.bfloat16)
+
+    def q8_step(q, k, v):
+        return jax.grad(
+            lambda q, k, v: pallas_flash_attention(
+                q, k, v, causal=True, interpret=True, compute_dtype="int8",
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    assert audit_precision_flow(q8_step, q, kv, kv, label="q8") == []
+
+    def q8_feed(q, k, v):
+        payload = quant.pack_kv(k, v, v_block=8)
+        feed = quant.payload_kernel_feed(payload, 8)
+        p = pallas_flash_partials(
+            q, None, None, scale=8 ** -0.5, causal_offset=0,
+            compute_dtype="int8", kv_quantized=feed, block_q=8, block_k=8,
+            interpret=True,
+        )
+        out, lse = finalize_partials(p)
+        return out.sum() + lse.sum()
+
+    assert audit_precision_flow(q8_feed, q, kv, kv, label="q8_feed") == []
+
+
+# ----------------------------------------------------------------------
+# validation surfaces
+# ----------------------------------------------------------------------
+
+
+def test_validation_surfaces(rng, mesh):
+    q, k, v = make_qkv(rng, b=4, h=2, hk=2, n=32, d=8)
+    with pytest.raises(ValueError, match="compute_dtype"):
+        pallas_flash_attention(q, k, v, causal=True, compute_dtype="fp4")
+    with pytest.raises(NotImplementedError, match="bf16 this round"):
+        pallas_flash_backward(
+            q, q, k, v, jnp.zeros(q.shape[:3]), jnp.zeros(q.shape[:3]),
+            scale=1.0, compute_dtype="int8",
+        )
+    qspec = P("data", None, "seq", None)
+    with pytest.raises(ValueError, match="Pallas kernels only"):
+        shard_map(
+            lambda q, k, v: ring_flash_attention(
+                q, k, v, None, "seq", causal=True, impl="xla",
+                compute_dtype="int8",
+            ),
+            mesh=mesh, in_specs=(qspec,) * 3, out_specs=qspec,
+        )(q, k, v)
+    # the dispatcher refuses a silent bf16 fallback
+    from ring_attention_tpu import ops
+
+    with pytest.raises(ValueError, match="Pallas"):
+        ops.attention(q, k, v, causal=True, impl="xla",
+                      compute_dtype="int8")
+    # kv_quantized at the wrong granularity names the fitted block
+    feed = quant.quantize_kv_blocks(k, v, 8)
+    with pytest.raises(ValueError, match="fitted block_k"):
+        pallas_flash_partials(
+            q, None, None, scale=1.0, causal_offset=0,
+            compute_dtype="int8", kv_quantized=feed, block_q=16, block_k=16,
+        )

@@ -434,6 +434,55 @@ def test_ring_comms_accounting_compression_and_counter():
         )
 
 
+def test_ring_comms_accounting_compute_dtype():
+    """PR 13 terms as numbers.  compute_dtype="int8": the matmul FEED
+    shrinks to 1 byte/element (q + k + v per hop), the f32 (acc, m, l)
+    accumulator bytes are INVARIANT (the precision auditor's contract as
+    a pinned number), the wire terms are untouched (quantized matmuls
+    change what the kernels read, never what the ring moves), and the
+    overlap model's compute leg runs at the 2x int8 MXU rate — less
+    compute time available to hide the same transfer."""
+    kw = dict(ring_size=8, seq_len=8192, kv_heads=8, dim_head=64,
+              dtype_bytes=2)
+    bf16 = ring_comms_accounting(**kw)
+    q8 = ring_comms_accounting(compute_dtype="int8", **kw)
+    n_chunk = 8192 // 8
+    # feed: q (8 heads) + k + v (8 kv heads) rows of the held chunk
+    assert q8["matmul_operand_bytes"] == 3 * 8 * n_chunk * 64
+    assert bf16["matmul_operand_bytes"] == 2 * 3 * 8 * n_chunk * 64
+    # the f32 (acc, m, l) state: (d + 2) f32 per (head, token), invariant
+    expected_acc = 4 * 8 * n_chunk * (64 + 2)
+    assert q8["accumulator_bytes"] == expected_acc
+    assert bf16["accumulator_bytes"] == expected_acc
+    # wire terms untouched
+    for key in ("hop_bytes", "fwd_collectives", "bwd_collectives",
+                "ring_bytes_per_step", "ring_bytes_per_step_bwd"):
+        assert q8[key] == bf16[key], key
+    # int8 compute finishes in half the time -> overlap can only drop
+    assert q8["hop_overlap_fraction"] <= bf16["hop_overlap_fraction"]
+    assert q8["compute_dtype"] == "int8" and bf16["compute_dtype"] is None
+    with pytest.raises(ValueError, match="compute_dtype"):
+        ring_comms_accounting(compute_dtype="fp8", **kw)
+
+
+def test_train_memory_estimate_compute_dtype():
+    """train_memory_estimate's int8 keys: operand bytes quarter from f32
+    (halve from bf16), accumulator bytes invariant, peak untouched (the
+    FFN/CE transients dominate every modeled shape)."""
+    from ring_attention_tpu.utils.telemetry import train_memory_estimate
+
+    kw = dict(seq_len=4096, dim=256, depth=2, heads=4, vocab=256,
+              n_params=1_000_000, dtype_bytes=2)
+    bf16 = train_memory_estimate(**kw)
+    q8 = train_memory_estimate(compute_dtype="int8", **kw)
+    assert bf16["attn_operand_bytes"] == 3 * 4096 * 256 * 2
+    assert q8["attn_operand_bytes"] == 3 * 4096 * 256
+    expected_acc = 4096 * (256 + 2 * 4) * 4
+    assert q8["attn_accumulator_bytes"] == expected_acc
+    assert bf16["attn_accumulator_bytes"] == expected_acc
+    assert q8["peak_hbm_bytes"] == bf16["peak_hbm_bytes"]
+
+
 def test_attention_logit_summaries_match_dense_oracle(rng):
     q = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)

@@ -92,6 +92,18 @@ def test_tpu_kernel_validate_hybrid_flag_parses():
     assert "--hybrid" in proc.stdout
 
 
+def test_tpu_kernel_validate_q8_flag_parses():
+    """``--q8`` (the int8 compute sweep, PR 13) must be a real flag —
+    same contract as ``--segments``: a broken flag is otherwise only
+    discovered when a scarce TPU window opens."""
+    proc = subprocess.run(
+        [sys.executable, KERNEL_VALIDATE, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--q8" in proc.stdout
+
+
 def test_trace_report_compiles():
     py_compile.compile(TRACE_REPORT, doraise=True)
 

@@ -46,6 +46,12 @@ def main() -> None:
                          "documents — parity vs the per-document oracle, "
                          "compact-grid tile counts (trace-time doc skip), "
                          "and timed fwd packed vs plain causal")
+    ap.add_argument("--q8", action="store_true",
+                    help="int8 compute sweep (PR 13): parity of the "
+                         "quantized QK^T/PV kernels vs bf16 at the small "
+                         "shape, then timed int8 fwd per (block, head-dim) "
+                         "next to the bf16 rows — on silicon the int8 MXU "
+                         "rate is ~2x bf16 peak (docs/precision.md)")
     ap.add_argument("--hybrid", type=int, default=None, metavar="U",
                     help="hybrid Ulysses x Ring sweep: for every factoring "
                          "(u, r) of the available devices with u <= U, "
@@ -183,6 +189,24 @@ def main() -> None:
                         "tile accounting",
             }))
 
+    # ---- int8 compute sweep (--q8): parity at the small shape, then the
+    # timed section below adds int8 rows per (block, head-dim)
+    if args.q8:
+        q8_small = finalize_partials(
+            pallas_flash_partials(q, k, v, scale=scale, causal_offset=0,
+                                  compute_dtype="int8",
+                                  interpret=args.interpret)
+        )[0]
+        print(json.dumps({
+            "mode": "q8-parity", "parity_seq": n0,
+            "q8_vs_bf16_max_err": float(jnp.abs(
+                q8_small.astype(jnp.float32) - compact.astype(jnp.float32)
+            ).max()),
+            "q8_vs_oracle_max_err": float(jnp.abs(
+                q8_small.astype(jnp.float32) - oracle
+            ).max()),
+        }))
+
     # ---- hybrid Ulysses x Ring sweep (--hybrid U): parity + timed fwd at
     # each factoring of the available devices.  u == 1 is the pure-ring
     # baseline the other rows are read against; each row reports its ring
@@ -300,14 +324,20 @@ def main() -> None:
     k, v = (jax.random.normal(kk, (1, hk, seq, d), jnp.bfloat16) for kk in ks[1:])
     flops_fwd = 2 * 2 * seq * seq * h * d * 0.5
 
-    def fwd_chained(bq, bk, iters, doc_starts=None):
+    def fwd_chained(bq, bk, iters, doc_starts=None, compute_dtype=None,
+                    sweep_scale=None):
+        # one timing harness for every fwd row (bf16, packed, q8, d128):
+        # rows are read against each other, so they must measure the
+        # same chained computation
+        row_scale = scale if sweep_scale is None else sweep_scale
+
         @jax.jit
         def chained(q, k, v):
             def body(c, _):
                 p = pallas_flash_partials(
-                    c, k, v, scale=scale, causal_offset=0,
+                    c, k, v, scale=row_scale, causal_offset=0,
                     block_q=bq, block_k=bk, interpret=args.interpret,
-                    doc_starts=doc_starts,
+                    doc_starts=doc_starts, compute_dtype=compute_dtype,
                 )
                 o = finalize_partials(p)[0]
                 return c + 1e-3 * o.astype(c.dtype), p.m[0, 0, 0]
@@ -334,6 +364,60 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - sweep must survive rejects
             print(json.dumps({
                 "mode": "fwd", "seq": seq, "block_q": bq, "block_k": bk,
+                "error": f"{type(e).__name__}: {str(e)[:160]}",
+            }))
+
+    # ---- int8 timed fwd (--q8): same (block_q, block_k) grid as the
+    # bf16 sweep above at the configured head dim, plus a d=128 row —
+    # "per (block, head-dim)" so the int8 MXU win is readable against the
+    # bf16 rows it sits next to (vs_bf16_peak > 1.0 is the win, not an
+    # accounting error: the TFLOPs are counted against useful flops)
+    if args.q8:
+        for bq, bk in pairs:
+            try:
+                compile_s, secs = timed_chained(
+                    fwd_chained(bq, bk, iters, compute_dtype="int8"),
+                    (q, k, v), iters,
+                )
+                print(json.dumps({
+                    "mode": "fwd-q8", "seq": seq, "dim_head": d,
+                    "block_q": bq, "block_k": bk,
+                    "tflops": round(flops_fwd / secs / 1e12, 1),
+                    "ms": round(secs * 1e3, 1),
+                    "compile_s": round(compile_s, 1),
+                }))
+            except Exception as e:  # noqa: BLE001 - sweep survives rejects
+                print(json.dumps({
+                    "mode": "fwd-q8", "seq": seq, "dim_head": d,
+                    "block_q": bq, "block_k": bk,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}",
+                }))
+        d128 = 128
+        ks128 = jax.random.split(jax.random.PRNGKey(5), 3)
+        q128 = jax.random.normal(ks128[0], (1, h, seq, d128), jnp.bfloat16)
+        k128, v128 = (
+            jax.random.normal(kk, (1, hk, seq, d128), jnp.bfloat16)
+            for kk in ks128[1:]
+        )
+
+        try:
+            compile_s, secs = timed_chained(
+                fwd_chained(1024, 1024, iters, compute_dtype="int8",
+                            sweep_scale=d128**-0.5),
+                (q128, k128, v128), iters,
+            )
+            print(json.dumps({
+                "mode": "fwd-q8", "seq": seq, "dim_head": d128,
+                "block_q": 1024, "block_k": 1024,
+                "tflops": round(
+                    2 * 2 * seq * seq * h * d128 * 0.5 / secs / 1e12, 1
+                ),
+                "ms": round(secs * 1e3, 1),
+                "compile_s": round(compile_s, 1),
+            }))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "mode": "fwd-q8", "seq": seq, "dim_head": d128,
                 "error": f"{type(e).__name__}: {str(e)[:160]}",
             }))
 
