@@ -221,6 +221,32 @@ def _coverage_worker() -> None:
     print(json.dumps(coverage_fingerprint()))
 
 
+def _multihost_worker() -> None:
+    """Multihost dryrun fingerprint (bench phase 0e): the hierarchical
+    ``(dcn_data, data, ring[, ulysses])`` mesh's forward collective
+    counts + the machine-checked dcn-isolation verdict, from
+    ``analysis/contracts.py::dcn_collective_fingerprint`` on simulated
+    CPU devices.
+
+    This is the pod-scale placement contract as a pinned number: zero
+    ring/ulysses collectives over the dcn axis, proven from optimized
+    HLO — so a change that starts hopping rings over DCN shows up in the
+    perf trajectory (``analysis/perfgate.py`` gates the family exactly)
+    even on wedged-TPU rounds.  Env must precede the first jax import,
+    hence the subprocess."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from ring_attention_tpu.analysis.contracts import (
+        dcn_collective_fingerprint,
+    )
+
+    print(json.dumps(dcn_collective_fingerprint()))
+
+
 def _window262k_worker(extra: dict) -> None:
     """Sliding-window 262k certified-grid accounting (CPU-countable).
 
@@ -1521,6 +1547,19 @@ def main() -> None:
     else:
         result["window262k"] = {"error": (win_err or "failed")[-200:]}
 
+    # phase 0e — multihost dryrun (CPU-only, pre-probe): the DCN-aware
+    # collective fingerprint over the hierarchical mesh — zero ring/
+    # ulysses collectives over dcn_data, machine-checked, pinned as an
+    # exact perf-gate family even on wedged rounds
+    mh, mh_err = _run_attempt(
+        "cpu", 0, "multihost",
+        float(os.environ.get("BENCH_MH_BUDGET_S", 420)),
+    )
+    if mh is not None:
+        result["multihost_dryrun"] = mh
+    else:
+        result["multihost_dryrun"] = {"error": (mh_err or "failed")[-200:]}
+
     # phase 0c — train1m memory proof (CPU-only, pre-probe like the
     # fingerprint): chunked-vs-dense compiled peak temp bytes at equal
     # shape + the analytic 2^20-token peak-HBM estimate, so the
@@ -1874,6 +1913,8 @@ if __name__ == "__main__":
         if mode == "fingerprint":
             # env setup must precede the first jax import (see the worker)
             _fingerprint_worker()
+        elif mode == "multihost":
+            _multihost_worker()
         elif mode == "coverage":
             _coverage_worker()
         elif mode == "window262k":

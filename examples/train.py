@@ -33,6 +33,21 @@ def main() -> None:
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--depth", type=int, default=2)
     ap.add_argument("--ring-size", type=int, default=None)
+    ap.add_argument("--multihost", action="store_true",
+                    help="join a multi-process cluster via "
+                         "jax.distributed (coordinator discovered from "
+                         "the environment on TPU pods; set "
+                         "JAX_COORDINATOR_ADDRESS etc. elsewhere) — "
+                         "meshes then span every host and the elastic "
+                         "checkpoint writes one shard group per process "
+                         "(docs/resilience.md §multi-host)")
+    ap.add_argument("--dcn-data-size", type=int, default=None,
+                    help="hierarchical mesh: outermost pure-data-"
+                         "parallel axis over the slow DCN links between "
+                         "slices/processes; rings and ulysses groups "
+                         "then live strictly inside one group (defaults "
+                         "to the process count under --multihost; "
+                         "contract-proven by check_contracts.py)")
     ap.add_argument("--ulysses-size", type=int, default=None,
                     help="factor the sequence axis as ulysses x ring and "
                          "train with sequence_parallel='hybrid': all-to-all "
@@ -68,6 +83,19 @@ def main() -> None:
                          "moments leave HBM between steps); a no-op on "
                          "backends without an addressable host memory "
                          "space, e.g. jax 0.4.x CPU (docs/memory.md)")
+    ap.add_argument("--shard-opt-state", action="store_true",
+                    help="ZeRO-1: shard the optimizer state (Adam "
+                         "moments) over the data axes — both tiers on a "
+                         "hierarchical --dcn-data-size mesh — so per-"
+                         "chip moment memory divides by the data-"
+                         "parallel world; composes with "
+                         "--offload-opt-state (docs/resilience.md)")
+    ap.add_argument("--watchdog-deadline", type=float, default=None,
+                    help="heartbeat watchdog: abort (exit 114, flight "
+                         "incident dumped) when a step boundary takes "
+                         "longer than this many seconds — a wedged "
+                         "collective (dead peer, hung device) becomes a "
+                         "bounded restart instead of an eternal hang")
     ap.add_argument("--use-pallas", action="store_true",
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
     ap.add_argument("--bidirectional", action="store_true",
@@ -151,6 +179,17 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
     import jax
+
+    if args.multihost:
+        # join the cluster before ANY device query: jax.devices() must be
+        # the global list when the meshes are built (retry ladder + one-
+        # line coordinator diagnostics live in parallel/mesh.py)
+        from ring_attention_tpu.parallel import initialize_multihost
+
+        initialize_multihost()
+        print(f"multihost: process {jax.process_index()}/"
+              f"{jax.process_count()}, "
+              f"{len(jax.local_devices())} local devices")
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -181,11 +220,16 @@ def main() -> None:
     )
 
     n_dev = len(jax.devices())
+    n_proc = jax.process_count()
+    if args.dcn_data_size is None and n_proc > 1:
+        # multihost default: one dcn group per process, rings inside
+        args.dcn_data_size = n_proc
 
     # elastic resume plans the mesh BEFORE building it: when the job
     # comes back at a different device count and no explicit factoring
     # was requested, the checkpoint manifest's mesh descriptor + the new
-    # world pick the closest factoring (ring absorbs the change)
+    # world pick the closest factoring (ring absorbs the change, the
+    # dcn tier re-plans to the current process count)
     elastic_mgr = None
     guard = None
     if args.elastic_ckpt:
@@ -201,11 +245,15 @@ def main() -> None:
         manifest = elastic_mgr.latest_manifest()
         if (manifest is not None and args.ring_size is None
                 and args.ulysses_size is None):
-            plan, diags = remesh_plan(manifest.get("mesh"), n_dev)
+            plan, diags = remesh_plan(
+                manifest.get("mesh"), n_dev,
+                dcn_data_size=args.dcn_data_size or n_proc,
+            )
             for line in diags:
                 print(f"  {line}")
             args.ring_size = plan.get("ring_size")
             args.ulysses_size = plan.get("ulysses_size")
+            args.dcn_data_size = plan.get("dcn_data_size")
         # constructed here, INSTALLED just before the train loop: during
         # the multi-minute init/compile/restore window a latched signal
         # would get no drain check, so the default Ctrl-C behavior is
@@ -218,13 +266,18 @@ def main() -> None:
 
     ulysses = args.ulysses_size or 1
     hybrid = ulysses > 1
+    dcn = args.dcn_data_size or 1
+    inner_dev = n_dev // dcn  # per-dcn-group world
     if hybrid:
-        ring = args.ring_size or n_dev // ulysses
-        mesh = create_mesh(ring_size=ring, ulysses_size=ulysses)
+        ring = args.ring_size or inner_dev // ulysses
+        mesh = create_mesh(ring_size=ring, ulysses_size=ulysses,
+                           dcn_data_size=args.dcn_data_size)
         seq_shards = ulysses * ring
     else:
-        ring = args.ring_size or n_dev
-        mesh = create_mesh(ring_size=ring) if n_dev > 1 else None
+        ring = args.ring_size or inner_dev
+        mesh = create_mesh(
+            ring_size=ring, dcn_data_size=args.dcn_data_size
+        ) if n_dev > 1 else None
         seq_shards = ring
     print(f"devices={n_dev} mesh={dict(mesh.shape) if mesh else None}")
 
@@ -276,6 +329,18 @@ def main() -> None:
         base = rng.integers(0, 256, (args.batch, args.seq_len // 2))
         tokens = np.concatenate([base, base], axis=1).astype(np.int32)
 
+    if n_proc > 1:
+        # every process passes only ITS rows of the global batch: the
+        # batch dimension shards over (dcn_data, data) with one dcn
+        # group per process, so the local slab is a contiguous row range
+        if args.batch % n_proc:
+            ap.error(f"--batch {args.batch} must divide by the "
+                     f"{n_proc}-process cluster")
+        rows = args.batch // n_proc
+        row0 = jax.process_index() * rows
+        tokens = tokens[row0:row0 + rows]
+        if segments is not None:
+            segments = segments[row0:row0 + rows]
     if mesh is not None:
         # host array straight onto the mesh: batch over data, sequence over
         # the ring, one per-shard transfer (multi-host: each process passes
@@ -290,6 +355,17 @@ def main() -> None:
     params = model.init(jax.random.PRNGKey(0), tokens)
     opt = optax.adamw(3e-4)
     opt_state = opt.init(params)
+    if args.shard_opt_state:
+        if mesh is None:
+            ap.error("--shard-opt-state needs a mesh (more than 1 device)")
+        # seed the loop sharded; the step's in-graph constraint keeps the
+        # updated state sharded (utils/train.py)
+        from ring_attention_tpu.parallel import data_partition
+        from ring_attention_tpu.utils.train import shard_optimizer_state
+
+        opt_state = shard_optimizer_state(
+            opt_state, mesh, axis=data_partition(mesh)
+        )
     if args.offload_opt_state:
         # seed the loop host-side; the step keeps it there (utils/train.py)
         from ring_attention_tpu.utils import compat
@@ -320,6 +396,8 @@ def main() -> None:
         collect_metrics=collect,
         offload_opt_state=args.offload_opt_state,
         offload_mesh=mesh,
+        shard_opt_state=args.shard_opt_state,
+        shard_mesh=mesh,
     )
 
     # preemption-safe resume: atomic saves, keep-last-N, corrupt-checkpoint
@@ -436,7 +514,16 @@ def main() -> None:
             },
         ).install()
 
-    timer = StepTimer(tokens_per_step=tokens.size)
+    # heartbeat watchdog (docs/resilience.md): a step boundary further
+    # apart than the deadline means a wedged collective — abort with a
+    # flight incident so the supervisor restarts from the checkpoint
+    dog = None
+    if args.watchdog_deadline:
+        from ring_attention_tpu.elastic import Watchdog
+
+        dog = Watchdog(args.watchdog_deadline, recorder=recorder).start()
+
+    timer = StepTimer(tokens_per_step=tokens.size * max(n_proc, 1))
     loop_guard = recorder.guard() if recorder is not None else (
         contextlib.nullcontext()
     )
@@ -446,8 +533,11 @@ def main() -> None:
         with loop_guard:
             _train_loop(args, recorder, timer, train_step, params,
                         opt_state, metrics, stats, batch, collect, guarded,
-                        mgr, logger, start, mfu_flops, comms, peak, guard)
+                        mgr, logger, start, mfu_flops, comms, peak, guard,
+                        n_proc=n_proc, dog=dog)
     finally:
+        if dog is not None:
+            dog.stop()
         if elastic_mgr is not None:
             elastic_mgr.close()  # flush any in-flight async save
         if guard is not None:
@@ -461,7 +551,8 @@ def main() -> None:
 
 def _train_loop(args, recorder, timer, train_step, params, opt_state,
                 metrics, stats, batch, collect, guarded, mgr, logger,
-                start, mfu_flops, comms, peak, guard=None):
+                start, mfu_flops, comms, peak, guard=None, n_proc=1,
+                dog=None):
     from ring_attention_tpu.utils import achieved_mfu
     from ring_attention_tpu.utils.train import StepStats
 
@@ -470,6 +561,16 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
         if collect:
             ckpt["nonfinite"] = metrics.nonfinite
         return ckpt
+
+    def drain_requested(step: int) -> bool:
+        if guard is None:
+            return False
+        if n_proc > 1:
+            # one host's SIGTERM drains the whole pod: the flag OR-reduces
+            # across processes at the step boundary — the train step's
+            # own compiled program is untouched (elastic/preemption.py)
+            return guard.should_stop_cluster(step=step)
+        return guard.should_stop()
 
     for step in range(start, args.steps):
         if collect:
@@ -492,6 +593,8 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
         else:
             params, opt_state, loss = train_step(params, opt_state, *batch)
         timer.step(loss)
+        if dog is not None:
+            dog.beat(step)
         if step % args.log_every == 0 or step == args.steps - 1:
             skipped = int(stats.skipped) if (guarded or collect) else 0
             print(
@@ -517,7 +620,7 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
                     ) if sps > 0 else 0.0,
                     **comms,
                 )
-        if guard is not None and guard.should_stop():
+        if drain_requested(step):
             # preemption drain: this step FINISHED (we're at the step
             # boundary); save synchronously, dump the incident with its
             # trajectory, and leave the loop cleanly — the restarted job

@@ -559,12 +559,19 @@ class ContractReport:
 
 
 def _mesh_dims(mesh) -> dict[str, int]:
-    from ..parallel.mesh import RING_AXIS, SEQ_AXIS, ULYSSES_AXIS, seq_world
+    from ..parallel.mesh import (
+        DCN_DATA_AXIS,
+        RING_AXIS,
+        SEQ_AXIS,
+        ULYSSES_AXIS,
+        seq_world,
+    )
 
     shape = dict(mesh.shape)
     ring = shape.get(RING_AXIS) or shape.get(SEQ_AXIS) or 1
     return {
         "data": shape.get("data", 1),
+        "dcn": shape.get(DCN_DATA_AXIS, 1),
         "ring": ring,
         "ulysses": shape.get(ULYSSES_AXIS, 1),
         "world": seq_world(mesh),
@@ -596,10 +603,10 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
 
     from ..parallel.hybrid import hybrid_attention
     from ..parallel.mesh import (
-        DATA_AXIS,
         RING_AXIS,
         SEQ_AXIS,
         ULYSSES_AXIS,
+        data_partition,
         is_factored,
         seq_partition,
     )
@@ -627,14 +634,17 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
         raise ValueError(f"{strategy} runs on a plain (data, seq) mesh")
 
     rng = np.random.default_rng(0)
-    b = b * dims["data"]  # the batch must tile the data axis
+    # the batch must tile the full data-parallel degree (both tiers of a
+    # hierarchical mesh)
+    b = b * dims["data"] * dims["dcn"]
 
     def mk(h, n=seq):
         return jnp.asarray(rng.standard_normal((b, h, n, dim_head)),
                            jnp.float32)
 
-    spec = P(DATA_AXIS, None, seq_partition(mesh), None)
-    rep = P(DATA_AXIS, None, None, None)
+    dspec = data_partition(mesh)
+    spec = P(dspec, None, seq_partition(mesh), None)
+    rep = P(dspec, None, None, None)
     bucket = max(seq // dims["world"] // 2, 4)
 
     if strategy in ("ring", "striped", "counter", "ring_compressed",
@@ -710,7 +720,7 @@ def build_entry(strategy: str, mesh, *, b: int = 1, heads: int = 8,
             return ff.apply(p, x)
 
         x = jax.device_put(x, NamedSharding(
-            mesh, P(DATA_AXIS, seq_partition(mesh), None)
+            mesh, P(dspec, seq_partition(mesh), None)
         ))
         return ffn, (
             x,
@@ -1043,6 +1053,182 @@ def check_counter_collective_budget(**shape_kw) -> ContractReport:
     return report
 
 
+def hlo_dcn_isolation(
+    txt: str, mesh_shape: tuple[int, ...], axis_names: list[str]
+) -> list[str]:
+    """The pod-scale placement proof: ZERO sequence-parallel collectives
+    cross the ``dcn_data`` axis in optimized HLO.
+
+    Every collective-permute pair and every all-to-all / all-gather /
+    reduce-scatter replica group must keep the dcn coordinate fixed —
+    a ring hop or head all-to-all that touches two dcn groups is riding
+    the slow inter-slice links TASP (arXiv 2509.26541) places sequence
+    parallelism to avoid.  ``all-reduce`` is exempt: the once-per-step
+    gradient reduction is the ONE collective that legitimately spans DCN.
+    Returns one-line violations.
+    """
+    from ..parallel.mesh import DCN_DATA_AXIS
+
+    if DCN_DATA_AXIS not in axis_names:
+        return [f"mesh axes {axis_names} carry no {DCN_DATA_AXIS} axis — "
+                f"nothing to prove [rule: dcn-isolation]"]
+    dcn_i = axis_names.index(DCN_DATA_AXIS)
+    out: list[str] = []
+    for inst, pairs in enumerate(hlo_ppermute_pairs(txt)):
+        for s, t in pairs:
+            cs = _device_coords(s, mesh_shape)
+            ct = _device_coords(t, mesh_shape)
+            if cs[dcn_i] != ct[dcn_i]:
+                out.append(
+                    f"collective-permute #{inst}: pair {s}->{t} crosses "
+                    f"the dcn_data axis (coords {cs}->{ct}) — a ring hop "
+                    f"over DCN [rule: dcn-isolation]"
+                )
+    for kind in ("all-to-all", "all-gather", "reduce-scatter"):
+        inst_re = re.compile(
+            r"%?" + re.escape(kind) + r"(?:-start)?[.\d]* = [^\n]*"
+        )
+        for inst, line in enumerate(inst_re.findall(txt)):
+            groups = _parse_replica_groups(line)
+            if groups is None:
+                continue
+            if isinstance(groups, str):
+                out.append(
+                    f"{kind} #{inst}: unrecognized replica_groups format "
+                    f"{groups!r} — cannot verify dcn isolation "
+                    f"[rule: dcn-isolation]"
+                )
+                continue
+            for g in groups:
+                coords = {_device_coords(d, mesh_shape)[dcn_i] for d in g}
+                if len(coords) > 1:
+                    out.append(
+                        f"{kind} #{inst}: group {g} spans dcn_data "
+                        f"coordinates {sorted(coords)} [rule: dcn-isolation]"
+                    )
+    return out
+
+
+def jaxpr_collective_axis_names(closed_jaxpr) -> dict[str, set]:
+    """Axis names each collective primitive binds in a traced program —
+    the jaxpr half of the dcn-isolation proof (an ``axis_name`` is the
+    mesh axis the collective moves data over)."""
+    res: dict[str, set] = {}
+
+    def walk(jaxpr) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in JAXPR_COLLECTIVE_PRIMS:
+                axes = eqn.params.get("axis_name", ())
+                if not isinstance(axes, (tuple, list)):
+                    axes = (axes,)
+                res.setdefault(name, set()).update(str(a) for a in axes)
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    walk(sub)
+
+    walk(closed_jaxpr.jaxpr)
+    return res
+
+
+def check_dcn_isolation(
+    *, dcn: int = 2, ulysses: int = 2, directions=None, **shape_kw
+) -> list[ContractReport]:
+    """The hierarchical-mesh contract rows: the ring and hybrid entries
+    compiled over a ``(dcn_data, data, ...)`` mesh hold their ordinary
+    collective contracts AND provably issue zero sequence-parallel
+    collectives over the dcn axis — from optimized HLO
+    (:func:`hlo_dcn_isolation`) and from the jaxpr walk
+    (:func:`jaxpr_collective_axis_names`).  Rows: ``ring_dcn`` always,
+    ``hybrid_dcn`` when the per-group world still factors as
+    ring x ulysses."""
+    import jax
+
+    from ..parallel.mesh import DCN_DATA_AXIS, create_mesh
+    from ..utils import compat
+
+    n = len(jax.devices())
+    if n % dcn or n // dcn < 2:
+        raise ValueError(
+            f"check_dcn_isolation: need >= {2 * dcn} devices factorable "
+            f"by dcn={dcn}, have {n}"
+        )
+    inner = n // dcn
+    cases = [("ring", create_mesh(dcn_data_size=dcn, ring_size=inner))]
+    if inner % ulysses == 0 and inner // ulysses >= 2:
+        cases.append((
+            "hybrid",
+            create_mesh(dcn_data_size=dcn, ring_size=inner // ulysses,
+                        ulysses_size=ulysses),
+        ))
+    reports: list[ContractReport] = []
+    for strategy, mesh in cases:
+        mesh_shape = tuple(mesh.shape.values())
+        axis_names = list(mesh.shape.keys())
+        fn, args, dims = build_entry(strategy, mesh, **shape_kw)
+        dirs = directions or CONTRACTS[strategy].get(
+            "directions", ("fwd", "fwdbwd")
+        )
+        for direction in dirs:
+            dfn = _direction_fn(fn, direction)
+            report = ContractReport(
+                strategy=f"{strategy}_dcn", direction=direction,
+                impl=CONTRACTS[strategy]["impl"], mesh_shape=mesh_shape,
+                dims=dims,
+            )
+            txt = compat.jit(dfn).lower(*args).compile().as_text()
+            report.counts = hlo_collective_counts(txt)
+            report.expected = expected_counts(strategy, direction, dims)
+            # the ordinary contract (exact counts, axis discipline, no
+            # undeclared kinds) still holds at the dcn factoring...
+            report.violations.extend(verify_hlo(
+                strategy, direction, txt, dims, mesh_shape, axis_names,
+            ))
+            # ...plus the hierarchical placement rule itself
+            report.violations.extend(
+                hlo_dcn_isolation(txt, mesh_shape, axis_names)
+            )
+            axes_by_prim = jaxpr_collective_axis_names(
+                jax.make_jaxpr(dfn)(*args)
+            )
+            report.jaxpr_counts = {
+                prim: sorted(axes) for prim, axes in axes_by_prim.items()
+            }
+            for prim, axes in axes_by_prim.items():
+                if DCN_DATA_AXIS in axes:
+                    report.violations.append(
+                        f"{strategy}_dcn/{direction} (traced): {prim} "
+                        f"binds the {DCN_DATA_AXIS} axis — sequence "
+                        f"parallelism crossed DCN [rule: dcn-isolation]"
+                    )
+            reports.append(report)
+    return reports
+
+
+def dcn_collective_fingerprint(*, dcn: int = 2, ulysses: int = 2) -> dict:
+    """The multihost-dryrun comms signature for the bench JSON (phase
+    0e): per-row forward collective counts over the hierarchical
+    ``(dcn_data, ...)`` mesh, plus the machine-checked verdict that no
+    sequence-parallel collective crossed the dcn axis.  CPU-runnable —
+    it lands even on wedged-TPU rounds, and ``analysis/perfgate.py``
+    gates it exactly like the flat-mesh fingerprint."""
+    out: dict[str, Any] = {}
+    ok = True
+    for report in check_dcn_isolation(
+        dcn=dcn, ulysses=ulysses, directions=("fwd",)
+    ):
+        out[report.strategy] = {
+            k.replace("collective-permute", "ppermute")
+             .replace("all-to-all", "all_to_all")
+             .replace("all-gather", "all_gather")
+             .replace("all-reduce", "all_reduce"): v
+            for k, v in sorted(report.counts.items())
+        }
+        ok = ok and report.ok
+    out["dcn_ok"] = ok
+    return out
+
+
 def dims_str(dims: dict[str, int]) -> str:
     return ", ".join(f"{k}={v}" for k, v in sorted(dims.items()))
 
@@ -1063,6 +1249,11 @@ def run_contract_suite(strategies=None, *, scan: bool = True,
         reports.append(check_hybrid_hop_reduction(**shape_kw))
     if "counter" in strategies and "ring" in strategies:
         reports.append(check_counter_collective_budget(**shape_kw))
+    if "ring" in strategies:
+        import jax
+
+        if len(jax.devices()) >= 4:
+            reports.extend(check_dcn_isolation(**shape_kw))
     return reports
 
 

@@ -195,6 +195,17 @@ class BenchRound:
             return {k: v for k, v in fp.items() if k != "gate_schema"}
         return None
 
+    @property
+    def multihost(self) -> dict[str, Any] | None:
+        """The multihost-dryrun DCN fingerprint (bench phase 0e):
+        per-row forward collective counts over the hierarchical
+        ``(dcn_data, ...)`` mesh + the dcn-isolation verdict
+        (``analysis/contracts.py::dcn_collective_fingerprint``)."""
+        fp = self.payload.get("multihost_dryrun")
+        if isinstance(fp, dict) and "error" not in fp:
+            return {k: v for k, v in fp.items() if k != "gate_schema"}
+        return None
+
 
 @dataclass
 class History:
@@ -364,14 +375,17 @@ def collect_current(
     ),
     compiled: bool = True,
     coverage: bool = True,
+    multihost: bool = True,
 ) -> dict[str, Any]:
     """The current build's CPU gate signals.
 
     ``strategies=None`` skips the (compile-paying) fingerprint;
     ``compiled=False`` skips the reference-step compile — the arithmetic
     comms table and the (numpy-only) tile-coverage fingerprint always
-    land.  Each skipped family is simply absent, and :func:`check` notes
-    absent families instead of passing them silently.
+    land.  ``multihost=False`` skips the DCN dryrun fingerprint (it
+    needs >= 4 devices).  Each skipped family is simply absent, and
+    :func:`check` notes absent families instead of passing them
+    silently.
     """
     import jax
 
@@ -388,6 +402,10 @@ def collect_current(
         from .contracts import collective_fingerprint
 
         signals["fingerprint"] = collective_fingerprint(tuple(strategies))
+    if multihost and len(jax.devices()) >= 4:
+        from .contracts import dcn_collective_fingerprint
+
+        signals["multihost"] = dcn_collective_fingerprint()
     if compiled:
         signals["compiled"] = compiled_reference_signals()
     return signals
@@ -430,7 +448,7 @@ def check_baseline(
     base_signals = baseline.get("signals", baseline)
 
     # exact families -----------------------------------------------------
-    for family in ("fingerprint", "comms", "coverage"):
+    for family in ("fingerprint", "comms", "coverage", "multihost"):
         base = base_signals.get(family)
         cur = current.get(family)
         if base is None:
@@ -589,7 +607,8 @@ def check_history(
                 ))
     # fingerprint drift between consecutive carrying rounds ---------------
     for family, getter in (("fingerprint", lambda r: r.fingerprint),
-                           ("coverage", lambda r: r.coverage)):
+                           ("coverage", lambda r: r.coverage),
+                           ("multihost", lambda r: r.multihost)):
         fps = [(r.number, getter(r)) for r in history.rounds
                if getter(r) is not None]
         for (n0, fp0), (n1, fp1) in zip(fps, fps[1:]):
@@ -620,7 +639,7 @@ def _downgrade_acknowledged_drift(
     """
     acknowledged = {
         s for s in baseline_report.checked
-        if s.startswith(("fingerprint.", "coverage."))
+        if s.startswith(("fingerprint.", "coverage.", "multihost."))
         and not any(f.series == s for f in baseline_report.findings)
     }
     kept: list[GateFinding] = []

@@ -21,22 +21,30 @@ contracts (``elastic/verify.py``).
 from . import chaos
 from .checkpoint import (
     AsyncSaveError,
+    BarrierTimeout,
     ElasticCheckpointManager,
     MANIFEST_FORMAT,
     MANIFEST_VERSION,
+    cross_process_barrier,
     load_manifest,
 )
-from .preemption import PREEMPT_FAULT, PreemptionGuard
+from .preemption import PREEMPT_FAULT, PreemptionGuard, broadcast_drain
 from .verify import run_elastic_suite
+from .watchdog import WATCHDOG_EXIT_CODE, Watchdog
 
 __all__ = [
     "AsyncSaveError",
+    "BarrierTimeout",
     "ElasticCheckpointManager",
     "MANIFEST_FORMAT",
     "MANIFEST_VERSION",
     "PREEMPT_FAULT",
     "PreemptionGuard",
+    "WATCHDOG_EXIT_CODE",
+    "Watchdog",
+    "broadcast_drain",
     "chaos",
+    "cross_process_barrier",
     "load_manifest",
     "run_elastic_suite",
 ]

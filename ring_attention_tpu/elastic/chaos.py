@@ -37,6 +37,7 @@ a loss trajectory that continues where the dead process left off.
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -51,6 +52,10 @@ CHAOS_EXIT_CODE = 113
 # environment variable arm_from_env reads: comma-separated
 # ``name`` / ``name=value`` fault specs
 CHAOS_ENV = "RING_ATTN_CHAOS"
+
+# cluster spec a spawned worker reads at startup to join a
+# jax.distributed cluster: "<process_id>:<num_processes>:<port>"
+CLUSTER_ENV = "RING_ATTN_CLUSTER"
 
 # the elastic checkpointer's planted kill points (elastic/checkpoint.py)
 KILL_MID_SHARD = "elastic_kill_mid_shard"
@@ -114,6 +119,28 @@ def arm_from_env(environ: Mapping[str, str] | None = None) -> list[str]:
     return armed
 
 
+def cluster_from_env(
+    environ: Mapping[str, str] | None = None,
+) -> tuple[int, int, str] | None:
+    """Parse :data:`CLUSTER_ENV` (``"<pid>:<nproc>:<port>"``) into
+    ``(process_id, num_processes, port)``, or None when the worker runs
+    standalone.  The spawned-worker half of :meth:`ChaosWorker.run_cluster`:
+    call at startup and feed ``initialize_multihost``."""
+    spec = (environ if environ is not None else os.environ).get(
+        CLUSTER_ENV, ""
+    )
+    if not spec:
+        return None
+    try:
+        pid, nproc, port = spec.split(":")
+        return int(pid), int(nproc), port
+    except ValueError as e:
+        raise ValueError(
+            f"{CLUSTER_ENV}={spec!r}: want '<process_id>:<num_processes>"
+            f":<port>'"
+        ) from e
+
+
 def hang(name: str = "hang_collective") -> float:
     """Host-side injected delay: sleep for the armed value (seconds) and
     return how long was slept (0.0 when disarmed)."""
@@ -136,6 +163,14 @@ def delay_tap(x, name: str = "hang_collective"):
     :func:`~...utils.resilience.nan_tap`, the armed/disarmed decision is
     fetched from the host each run, so the SAME compiled step can be
     healthy for k steps and hang at exactly step k.
+
+    Multi-process caveat: in a ``jax.distributed`` SPMD program the
+    callback of a replicated value executes only on the process holding
+    its first shard — process 0.  Arm the wedge THERE; every peer then
+    wedges inside its own (real) cross-process collective waiting for
+    process 0's contribution, which is the symmetric cluster-wide stall
+    the watchdog pin wants (``tests/test_multihost.py``).  A wedge
+    armed on a non-zero process silently no-ops in-graph.
     """
     import jax
     import jax.numpy as jnp
@@ -170,6 +205,13 @@ def corrupt_file(path: str, mode: str = "truncate") -> None:
         )
 
 
+def free_port() -> int:
+    """A free localhost TCP port for a spawned cluster's coordinator."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 class ChaosWorker:
     """Spawn-and-kill driver for a training worker on virtual CPU devices.
 
@@ -183,6 +225,14 @@ class ChaosWorker:
         assert r.returncode == CHAOS_EXIT_CODE
         r = w.run(devices=2)                              # re-mesh resume
         assert r.returncode == 0
+
+    :meth:`run_cluster` is the pod-scale form: N worker processes join
+    ONE ``jax.distributed`` cluster (coordinator on a free localhost
+    port, spec delivered via :data:`CLUSTER_ENV`) and the chaos faults
+    arm in exactly ONE victim — kill one worker of a live cluster at any
+    commit window, then :meth:`run` restarts at the surviving process
+    count and the elastic checkpoint re-meshes (``tests/test_multihost.py``
+    drives the full matrix).
     """
 
     def __init__(
@@ -224,3 +274,81 @@ class ChaosWorker:
             capture_output=True, text=True, env=env, cwd=self.cwd,
             timeout=self.timeout,
         )
+
+    def run_cluster(
+        self,
+        *,
+        processes: int,
+        devices_per_process: int,
+        chaos: Mapping[str, Any] | Iterable[str] | None = None,
+        chaos_process: int = 0,
+        extra_env: Mapping[str, str] | None = None,
+        extra_args: Sequence[str] = (),
+        port: int | None = None,
+    ) -> list[subprocess.CompletedProcess]:
+        """One live multi-process cluster run; chaos arms in ONE victim.
+
+        Spawns ``processes`` copies of the worker command, each owning
+        ``devices_per_process`` virtual CPU devices, joined through a
+        ``jax.distributed`` coordinator on a localhost port.  The chaos
+        faults land only in ``chaos_process``'s environment — the other
+        workers run clean and must convert the victim's death into a
+        bounded error (checkpoint barrier timeout), never a hang.
+
+        Returns one :class:`subprocess.CompletedProcess` per worker, in
+        process order.  Outputs are reaped PER WORKER even when some hang
+        past the timeout (those report ``returncode=None``-style kill
+        codes with whatever partial output they produced) — misattributed
+        logs are how multi-process failures become undebuggable.
+        """
+        port = port or free_port()
+        env_base = dict(os.environ)
+        env_base.pop("XLA_FLAGS", None)
+        env_base["JAX_PLATFORMS"] = "cpu"
+        env_base["RING_ATTN_CHAOS_DEVICES"] = str(devices_per_process)
+        if extra_env:
+            env_base.update(extra_env)
+        procs = []
+        for pid in range(processes):
+            env = dict(env_base)
+            env[CLUSTER_ENV] = f"{pid}:{processes}:{port}"
+            if chaos and pid == chaos_process:
+                items = (chaos.items() if isinstance(chaos, Mapping)
+                         else ((c, True) for c in chaos))
+                env[CHAOS_ENV] = ",".join(
+                    name if value is True else f"{name}={value}"
+                    for name, value in items
+                )
+            else:
+                env.pop(CHAOS_ENV, None)
+            procs.append(subprocess.Popen(
+                self.argv + list(extra_args),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=self.cwd,
+            ))
+        results: list[subprocess.CompletedProcess | None] = [None] * processes
+        deadline = time.monotonic() + self.timeout
+        try:
+            for pid, p in enumerate(procs):
+                budget = max(deadline - time.monotonic(), 0.01)
+                try:
+                    out, _ = p.communicate(timeout=budget)
+                except subprocess.TimeoutExpired:
+                    continue  # reaped (with partial output) below
+                results[pid] = subprocess.CompletedProcess(
+                    p.args, p.returncode, out, ""
+                )
+        finally:
+            for pid, p in enumerate(procs):
+                if results[pid] is not None:
+                    continue
+                p.kill()
+                try:
+                    out, _ = p.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 — corpse refuses to talk
+                    out = ""
+                results[pid] = subprocess.CompletedProcess(
+                    p.args, p.returncode if p.returncode is not None
+                    else -9, out, ""
+                )
+        return list(results)

@@ -44,6 +44,30 @@ restored bit-exactly either way, so the loss trajectory continues within
 reduction-order noise (pinned in ``tests/test_elastic.py``).  Corrupt or
 truncated shard files fail the digest check and fall back (one warning)
 to the newest older intact step, exactly like PR 1's manager.
+
+**Multi-process (pod-scale) protocol.**  Under ``jax.distributed``
+(``jax.process_count() > 1``, shared checkpoint filesystem) the same
+manager coordinates every process:
+
+- shard ownership is GLOBAL: the owner of each distinct shard region is
+  the lowest-id device holding it (``leaf.global_shards``), and each
+  process writes only the regions its addressable devices own — replicas
+  are stored once cluster-wide, write sets are disjoint by construction;
+- all processes write into ONE deterministic staging directory; each
+  records its shard files + digests in a ``shards_pNNNNN.json`` sidecar;
+- process 0 merges the sidecars into the manifest and commits it LAST —
+  behind a cross-process barrier (the ``jax.distributed`` coordinator's
+  KV barrier, not a device collective, so async-save threads never race
+  the training step's collectives) — with the same single atomic rename.
+  A kill of ANY worker at ANY instant leaves the previous-or-new
+  invariant intact: a dead peer turns the barrier into a one-line
+  ``BarrierTimeout`` on the survivors (never an eternal hang), and the
+  half-written staging dir is swept later;
+- restore is read-only and per-process: every process assembles only its
+  addressable target shards from whichever stored shards overlap them,
+  so a checkpoint written by 2 processes restores on 1 (and vice versa)
+  through the identical gather/scatter path, bit-exactly
+  (``tests/test_multihost.py`` pins 2 -> 1 and 1 -> 2).
 """
 
 from __future__ import annotations
@@ -161,14 +185,59 @@ class AsyncSaveError(RuntimeError):
     silently cost every subsequent checkpoint too."""
 
 
+class BarrierTimeout(RuntimeError):
+    """A cross-process checkpoint barrier expired — a peer process died
+    (or wedged) mid-protocol.  The save fails with THIS one-line error on
+    every survivor instead of hanging them; the half-written staging
+    directory is invisible to ``all_steps`` and swept by a later save."""
+
+
+def _distributed_client():
+    """The jax.distributed coordinator's KV-store client, or None when
+    the process runs standalone.  Its ``wait_at_barrier`` is a host-side
+    rendezvous — safe from the async-save background thread, where a
+    device collective would interleave with the training step's."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — older jax layouts: no client
+        return None
+
+
+def cross_process_barrier(name: str, *, timeout_s: float = 600.0) -> None:
+    """Block until every process reaches barrier ``name`` (unique per
+    rendezvous).  Single-process: a no-op.  A peer that never arrives
+    turns into :class:`BarrierTimeout` after ``timeout_s``."""
+    if jax.process_count() <= 1:
+        return
+    client = _distributed_client()
+    if client is None:
+        # no coordinator client exposed on this build: the device-level
+        # barrier still rendezvouses (main thread only — documented)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+        return
+    try:
+        client.wait_at_barrier(name, int(timeout_s * 1000))
+    except Exception as e:  # noqa: BLE001 — jaxlib raises backend types
+        raise BarrierTimeout(
+            f"cross-process barrier {name!r} expired after {timeout_s:.0f}s "
+            f"— a peer process died or wedged mid-checkpoint "
+            f"({type(e).__name__}: {e})"
+        ) from e
+
+
 class ElasticCheckpointManager:
     """Sharded async checkpoints in ``<directory>/step_<8 digits>/``.
 
-    See the module docstring for the commit protocol.  Like PR 1's
-    manager this targets single-process jobs (every device addressable);
-    the layout is multi-process-shaped (shard files are grouped by owner
-    and the manifest records a process count) so the pod-scale extension
-    is a new writer, not a new format.
+    See the module docstring for the commit protocol — including the
+    multi-process one: under ``jax.distributed`` every process constructs
+    the SAME manager over a shared filesystem, each writes only its
+    addressable shard groups, and process 0 commits the manifest last
+    behind a cross-process barrier (``barrier_timeout_s`` bounds how long
+    a survivor waits on a dead peer).
     """
 
     def __init__(
@@ -179,19 +248,24 @@ class ElasticCheckpointManager:
         async_save: bool = True,
         lock_stale_age: float = 30.0,
         lock_timeout: float = 600.0,
+        barrier_timeout_s: float = 600.0,
     ) -> None:
         if keep < 1:
             raise ValueError(
                 f"ElasticCheckpointManager: keep must be >= 1, got {keep}"
             )
-        if jax.process_count() > 1:
-            raise RuntimeError(
-                "ElasticCheckpointManager is single-process for now; "
-                "multi-host jobs keep using save_checkpoint (Orbax)"
-            )
         self.directory = os.fspath(os.path.abspath(directory))
         self.keep = keep
         self.async_save = async_save
+        self.barrier_timeout_s = float(
+            os.environ.get("RING_ATTN_ELASTIC_BARRIER_S")
+            or barrier_timeout_s
+        )
+        self._proc = int(jax.process_index())
+        self._nproc = int(jax.process_count())
+        # barrier ids must be unique per rendezvous: saves/restores run in
+        # lockstep across processes, so a per-manager counter agrees
+        self._sync = 0
         os.makedirs(self.directory, exist_ok=True)
         self._dirlock = DirectoryLock(
             self.directory, stale_age=lock_stale_age
@@ -203,6 +277,24 @@ class ElasticCheckpointManager:
         self._error: BaseException | None = None
         self.last_resume: dict | None = None
         self.last_manifest: dict | None = None
+
+    def _barrier(self, tag: str) -> None:
+        cross_process_barrier(
+            f"elastic:{os.path.basename(self.directory)}:{tag}",
+            timeout_s=self.barrier_timeout_s,
+        )
+
+    @property
+    def _mp_lock_budget(self) -> float:
+        """Process 0's directory-lock wait inside the multi-process
+        protocol: bounded by HALF the peers' barrier budget.  A worker
+        killed mid-commit leaves the lock held by a dead pid, and the
+        stale takeover must wait out ``stale_age`` — unbounded, process
+        0 would sit in that wait while every peer's rendezvous deadline
+        expired one by one.  Bounded, process 0 degrades (restore skips
+        the advisory sweep) or fails (save) BEFORE the barrier does, so
+        the whole cluster sees one coherent outcome."""
+        return min(self.lock_timeout, max(self.barrier_timeout_s / 2.0, 1.0))
 
     # -- directory bookkeeping ----------------------------------------
 
@@ -292,6 +384,57 @@ class ElasticCheckpointManager:
 
     # -- snapshot (synchronous half of an async save) -----------------
 
+    def _leaf_shards(self, leaf) -> list[dict]:
+        """This process's owned shard payloads of one array leaf.
+
+        Single-process: every distinct shard region once (replicas
+        deduped by index).  Multi-process: the owner of a region is the
+        LOWEST-id device holding it anywhere in the cluster
+        (``global_shards`` exposes every region's index; only the
+        addressable ones carry data) — so each region is stored exactly
+        once cluster-wide and the per-process write sets are disjoint.
+        """
+        np = _np()
+        if self._nproc > 1:
+            groups: dict[tuple, list] = {}
+            for shard in leaf.global_shards:
+                index = tuple(
+                    tuple(s.indices(d))
+                    for s, d in zip(shard.index, leaf.shape)
+                )
+                groups.setdefault(index, []).append(shard)
+            out = []
+            for shards in groups.values():
+                owner = min(
+                    shards, key=lambda s: int(getattr(s.device, "id", 0))
+                )
+                if owner.data is None:  # another process's region
+                    continue
+                arr = np.ascontiguousarray(np.asarray(owner.data))
+                out.append({
+                    "owner": int(getattr(owner.device, "id", 0)),
+                    "index": _norm_index(owner.index, leaf.shape),
+                    "bytes": np.frombuffer(arr.tobytes(), np.uint8),
+                })
+            return out
+        seen: dict[tuple, Any] = {}
+        for shard in leaf.addressable_shards:
+            index = tuple(
+                tuple(s.indices(d))
+                for s, d in zip(shard.index, leaf.shape)
+            )
+            if index in seen:  # replicated copy: store once
+                continue
+            seen[index] = shard
+        return [{
+            "owner": int(getattr(shard.device, "id", 0)),
+            "index": _norm_index(shard.index, leaf.shape),
+            "bytes": np.frombuffer(
+                np.ascontiguousarray(np.asarray(shard.data)).tobytes(),
+                np.uint8,
+            ),
+        } for shard in seen.values()]
+
     def _snapshot(self, state: Any) -> dict:
         """Copy every leaf's unique shards to host memory.
 
@@ -311,40 +454,26 @@ class ElasticCheckpointManager:
 
                 if isinstance(sharding, NamedSharding) and mesh is None:
                     mesh = sharding.mesh
-                seen: dict[tuple, Any] = {}
-                for shard in leaf.addressable_shards:
-                    index = tuple(
-                        tuple(s.indices(d))
-                        for s, d in zip(shard.index, leaf.shape)
-                    )
-                    if index in seen:  # replicated copy: store once
-                        continue
-                    seen[index] = shard
-                shards = []
-                for shard in seen.values():
-                    arr = np.ascontiguousarray(np.asarray(shard.data))
-                    shards.append({
-                        "owner": int(getattr(shard.device, "id", 0)),
-                        "index": _norm_index(shard.index, leaf.shape),
-                        "bytes": np.frombuffer(arr.tobytes(), np.uint8),
-                    })
                 snap_leaves.append({
                     "shape": [int(d) for d in leaf.shape],
                     "dtype": str(leaf.dtype),
                     "spec": _spec_to_json(sharding),
-                    "shards": shards,
+                    "shards": self._leaf_shards(leaf),
                 })
             else:
                 arr = np.ascontiguousarray(np.asarray(leaf))
+                # a host-side value is replicated by construction:
+                # process 0 stores the one copy
+                shards = [] if self._proc else [{
+                    "owner": 0,
+                    "index": [[0, int(d)] for d in arr.shape],
+                    "bytes": np.frombuffer(arr.tobytes(), np.uint8),
+                }]
                 snap_leaves.append({
                     "shape": [int(d) for d in arr.shape],
                     "dtype": str(arr.dtype),
                     "spec": None,
-                    "shards": [{
-                        "owner": 0,
-                        "index": [[0, int(d)] for d in arr.shape],
-                        "bytes": np.frombuffer(arr.tobytes(), np.uint8),
-                    }],
+                    "shards": shards,
                 })
         from ..parallel.mesh import mesh_descriptor
 
@@ -357,8 +486,89 @@ class ElasticCheckpointManager:
 
     # -- write (background half) --------------------------------------
 
-    def _write(self, step: int, snap: dict) -> str:
+    def _stage_shards(self, stage: str, snap: dict) -> tuple[list, dict]:
+        """Write THIS process's shard payloads into ``stage``: one
+        ``shard_dNNN.npz`` per owner device, fsync'd and digested.
+        Returns ``(leaf_table, files)`` — the per-leaf shard entries and
+        per-file digests this process contributes to the manifest."""
         np = _np()
+        groups: dict[str, dict[str, Any]] = {}
+        leaf_table = []
+        for i, leaf in enumerate(snap["leaves"]):
+            entries = []
+            for j, shard in enumerate(leaf["shards"]):
+                fname = f"shard_d{shard['owner']:03d}.npz"
+                key = f"L{i:05d}_{j:03d}"
+                groups.setdefault(fname, {})[key] = shard["bytes"]
+                entries.append({
+                    "file": fname,
+                    "key": key,
+                    "index": shard["index"],
+                })
+            leaf_table.append({
+                "shape": leaf["shape"],
+                "dtype": leaf["dtype"],
+                "spec": leaf["spec"],
+                "shards": entries,
+            })
+        files = {}
+        for fname in sorted(groups):
+            path = os.path.join(stage, fname)
+            with open(path, "wb") as f:
+                np.savez(f, **groups[fname])
+                f.flush()
+                os.fsync(f.fileno())
+            files[fname] = {
+                "sha256": _sha256(path),
+                "bytes": os.path.getsize(path),
+            }
+            # chaos: die with SOME shard files durable and the
+            # manifest absent — the torn-write window the commit
+            # protocol must make unobservable
+            chaos.chaos_point(chaos.KILL_MID_SHARD)
+        return leaf_table, files
+
+    def _commit(self, step: int, stage: str, final: str,
+                leaf_table: list, files: dict, snap: dict) -> None:
+        """Write the manifest LAST, fsync, then the one atomic rename."""
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "version": MANIFEST_VERSION,
+            "step": int(step),
+            "mesh": snap["mesh"],
+            "devices": snap["devices"],
+            "process_count": self._nproc,
+            "treedef": snap["treedef"],
+            "leaf_count": len(leaf_table),
+            "leaves": leaf_table,
+            "files": files,
+        }
+        man_path = os.path.join(stage, _MANIFEST)
+        with open(man_path, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(stage)
+        # chaos: die with a COMPLETE staging dir, commit rename
+        # not yet executed — next boot must resume the previous
+        # step and sweep this one
+        chaos.chaos_point(chaos.KILL_PRE_COMMIT)
+        backup = None
+        if os.path.isdir(final):
+            backup = final + ".old"
+            shutil.rmtree(backup, ignore_errors=True)
+            os.replace(final, backup)
+        os.replace(stage, final)  # THE commit: one atomic rename
+        _fsync_dir(self.directory)
+        # chaos: die right after the commit — next boot must see
+        # THIS step as valid, with only .old debris to sweep
+        chaos.chaos_point(chaos.KILL_POST_COMMIT)
+        if backup is not None:
+            shutil.rmtree(backup, ignore_errors=True)
+
+    def _write(self, step: int, snap: dict) -> str:
+        if self._nproc > 1:
+            return self._write_multiprocess(step, snap)
         with self._dirlock.locked(timeout=self.lock_timeout):
             self._sweep()
             final = self._step_dir(step)
@@ -366,81 +576,100 @@ class ElasticCheckpointManager:
             shutil.rmtree(stage, ignore_errors=True)
             os.makedirs(stage)
             try:
-                # group shard payloads by owner device -> one file per
-                # addressable-shard group
-                groups: dict[int, dict[str, Any]] = {}
-                leaf_table = []
-                for i, leaf in enumerate(snap["leaves"]):
-                    entries = []
-                    for j, shard in enumerate(leaf["shards"]):
-                        fname = f"shard_d{shard['owner']:03d}.npz"
-                        key = f"L{i:05d}_{j:03d}"
-                        groups.setdefault(fname, {})[key] = shard["bytes"]
-                        entries.append({
-                            "file": fname,
-                            "key": key,
-                            "index": shard["index"],
-                        })
-                    leaf_table.append({
-                        "shape": leaf["shape"],
-                        "dtype": leaf["dtype"],
-                        "spec": leaf["spec"],
-                        "shards": entries,
-                    })
-                files = {}
-                for fname in sorted(groups):
-                    path = os.path.join(stage, fname)
-                    with open(path, "wb") as f:
-                        np.savez(f, **groups[fname])
-                        f.flush()
-                        os.fsync(f.fileno())
-                    files[fname] = {
-                        "sha256": _sha256(path),
-                        "bytes": os.path.getsize(path),
-                    }
-                    # chaos: die with SOME shard files durable and the
-                    # manifest absent — the torn-write window the commit
-                    # protocol must make unobservable
-                    chaos.chaos_point(chaos.KILL_MID_SHARD)
-                manifest = {
-                    "format": MANIFEST_FORMAT,
-                    "version": MANIFEST_VERSION,
-                    "step": int(step),
-                    "mesh": snap["mesh"],
-                    "devices": snap["devices"],
-                    "process_count": int(jax.process_count()),
-                    "treedef": snap["treedef"],
-                    "leaf_count": len(leaf_table),
-                    "leaves": leaf_table,
-                    "files": files,
-                }
-                man_path = os.path.join(stage, _MANIFEST)
-                with open(man_path, "w") as f:
-                    json.dump(manifest, f, indent=1)
-                    f.flush()
-                    os.fsync(f.fileno())
-                _fsync_dir(stage)
-                # chaos: die with a COMPLETE staging dir, commit rename
-                # not yet executed — next boot must resume the previous
-                # step and sweep this one
-                chaos.chaos_point(chaos.KILL_PRE_COMMIT)
-                backup = None
-                if os.path.isdir(final):
-                    backup = final + ".old"
-                    shutil.rmtree(backup, ignore_errors=True)
-                    os.replace(final, backup)
-                os.replace(stage, final)  # THE commit: one atomic rename
-                _fsync_dir(self.directory)
-                # chaos: die right after the commit — next boot must see
-                # THIS step as valid, with only .old debris to sweep
-                chaos.chaos_point(chaos.KILL_POST_COMMIT)
-                if backup is not None:
-                    shutil.rmtree(backup, ignore_errors=True)
+                leaf_table, files = self._stage_shards(stage, snap)
+                self._commit(step, stage, final, leaf_table, files, snap)
             except BaseException:
                 shutil.rmtree(stage, ignore_errors=True)
                 raise
             self._prune()
             return final
+
+    def _write_multiprocess(self, step: int, snap: dict) -> str:
+        """The pod-scale writer: every process stages its own shard
+        groups into ONE shared staging directory, then process 0 merges
+        the per-process sidecars into the manifest and commits — the
+        manifest is still the last byte written before the one rename, so
+        the previous-or-new invariant holds under a kill of ANY worker at
+        ANY instant (a dead peer costs the survivors a
+        :class:`BarrierTimeout`, never a torn checkpoint)."""
+        sync = snap["sync"]
+        final = self._step_dir(step)
+        # deterministic shared name: every process must agree on it
+        # without communicating (swept age-based if a whole save dies)
+        stage = f"{final}.writing-mp"
+        if self._proc == 0:
+            # only process 0 takes the cross-manager directory lock: the
+            # in-job coordination is the barriers, and N processes
+            # contending one lock for a cooperative write would deadlock
+            self._dirlock.acquire(timeout=self._mp_lock_budget)
+        try:
+            if self._proc == 0:
+                self._sweep()
+                shutil.rmtree(stage, ignore_errors=True)
+                os.makedirs(stage)
+            self._barrier(f"s{sync}:staged")
+            leaf_table, files = self._stage_shards(stage, snap)
+            sidecar = os.path.join(stage, f"shards_p{self._proc:05d}.json")
+            with open(sidecar, "w") as f:
+                json.dump({"leaves": leaf_table, "files": files}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            # every process's shard files + sidecar durable before the
+            # manifest can exist
+            self._barrier(f"s{sync}:shards")
+            if self._proc == 0:
+                merged_leaves, merged_files = self._merge_sidecars(
+                    stage, snap
+                )
+                self._commit(
+                    step, stage, final, merged_leaves, merged_files, snap
+                )
+            # no process returns (and possibly starts the next save)
+            # until the commit rename happened
+            self._barrier(f"s{sync}:committed")
+            if self._proc == 0:
+                self._prune()
+            return final
+        except BaseException:
+            if self._proc == 0:
+                shutil.rmtree(stage, ignore_errors=True)
+            raise
+        finally:
+            if self._proc == 0:
+                self._dirlock.release()
+
+    def _merge_sidecars(self, stage: str, snap: dict) -> tuple[list, dict]:
+        """Join every process's sidecar into one manifest leaf table:
+        per-leaf shard entries concatenated in process order (owner
+        regions are disjoint by construction), digests unioned."""
+        n_leaves = len(snap["leaves"])
+        merged = [{
+            "shape": leaf["shape"],
+            "dtype": leaf["dtype"],
+            "spec": leaf["spec"],
+            "shards": [],
+        } for leaf in snap["leaves"]]
+        files: dict[str, Any] = {}
+        for proc in range(self._nproc):
+            path = os.path.join(stage, f"shards_p{proc:05d}.json")
+            try:
+                with open(path) as f:
+                    side = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                raise CheckpointCorruptError(
+                    f"process {proc} sidecar unreadable mid-commit "
+                    f"({e}) — peer died after its shards barrier?"
+                ) from e
+            if len(side["leaves"]) != n_leaves:
+                raise CheckpointCorruptError(
+                    f"process {proc} sidecar records "
+                    f"{len(side['leaves'])} leaves, expected {n_leaves}"
+                )
+            for mine, theirs in zip(merged, side["leaves"]):
+                mine["shards"].extend(theirs["shards"])
+            files.update(side["files"])
+            os.remove(path)  # sidecars never land in the committed step
+        return merged, files
 
     def _write_guarded(self, step: int, snap: dict) -> None:
         try:
@@ -473,6 +702,10 @@ class ElasticCheckpointManager:
         """
         self.wait()
         snap = self._snapshot(state)
+        # barrier-id generation: every process calls save in lockstep, so
+        # a per-manager counter names the same rendezvous on all of them
+        self._sync += 1
+        snap["sync"] = f"{self._sync}:{step}"
         sync = (not self.async_save) if block is None else block
         if sync:
             self._write(step, snap)
@@ -653,6 +886,30 @@ class ElasticCheckpointManager:
         ``NamedSharding`` (restored replicated over it).
         """
         from ..utils.resilience import LockTimeout
+
+        if self._nproc > 1:
+            # multi-process read: process 0 sweeps (under the lock), then
+            # everyone reads the same shared directory — the step choice
+            # is deterministic (same files, same fallback rule), and the
+            # trailing barrier keeps any process from starting the next
+            # save while a peer is still mid-read
+            self._sync += 1
+            if self._proc == 0:
+                try:
+                    with self._dirlock.locked(timeout=self._mp_lock_budget):
+                        self._sweep()
+                except LockTimeout:
+                    warnings.warn(
+                        f"ElasticCheckpointManager: directory lock "
+                        f"{self._dirlock.path} stuck; skipping the "
+                        f"pre-restore sweep",
+                        stacklevel=2,
+                    )
+            self._barrier(f"r{self._sync}:swept")
+            try:
+                return self._restore_unlocked(template, mesh, step)
+            finally:
+                self._barrier(f"r{self._sync}:read")
 
         # held for the whole read: the sweep recovers .old debris even
         # when the dead writer died holding the lock, and a concurrent

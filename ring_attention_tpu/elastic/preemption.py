@@ -15,6 +15,20 @@ injector (:data:`PREEMPT_FAULT` — ``resilience.inject("preempt_now")``),
 so every drain path is testable in-process, and a second signal while
 draining escalates to ``KeyboardInterrupt`` (the operator's "no really,
 die now").
+
+**Cluster-wide drain.**  On a multi-host pod the platform preempts ONE
+host; a drain that stops only that host leaves the others wedged in the
+next step's collectives waiting for a peer that will never arrive.
+:func:`broadcast_drain` turns any host's local flag into everyone's: one
+tiny compiled OR-reduction over the per-process flags, invoked from the
+step-boundary host hook (``make_train_step(on_step_end=...)``) — the
+TRAIN step's compiled program is untouched (the existing
+zero-extra-collectives HLO pin in ``tests/test_elastic.py`` covers it),
+and the broadcast's own program is one scalar all-gather per checked
+boundary, compiled once.  ``should_stop_cluster()`` is the drop-in
+cluster form of ``should_stop()``; every process then drains through the
+SAME save (the multi-process checkpoint barrier needs all of them) and
+exits cleanly.
 """
 
 from __future__ import annotations
@@ -29,6 +43,29 @@ from ..utils.resilience import get_injector
 PREEMPT_FAULT = "preempt_now"
 
 DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+def broadcast_drain(local: bool) -> bool:
+    """Global OR of every process's drain flag.
+
+    Single-process: the identity (no program runs at all).  Multi-process:
+    one scalar per process all-gathers through a tiny jitted program
+    (compiled once, reused every boundary) and any process's True drains
+    the whole cluster.  Runs from the HOST side of the step boundary —
+    never inside the train step's compiled program, whose collective
+    sequence stays byte-identical (the ``on_step_end`` HLO pin).
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return bool(local)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray(bool(local), dtype=np.bool_)
+    )
+    return bool(np.any(flags))
 
 
 class PreemptionGuard:
@@ -126,6 +163,31 @@ class PreemptionGuard:
         if get_injector().armed(PREEMPT_FAULT):
             self.signal_name = self.signal_name or "injected"
             self._requested.set()
+            return True
+        return False
+
+    def should_stop_cluster(self, every: int = 1, step: int = 0) -> bool:
+        """The cluster form of :meth:`should_stop`: a drain signal on ANY
+        process drains every process (:func:`broadcast_drain`).  The
+        whole pod must leave together — the multi-process checkpoint
+        commit and the next step's collectives both need all peers.
+
+        ``every``/``step`` thin the broadcast to every ``every``-th step
+        boundary when one scalar all-gather per step is too chatty (a
+        drain — even the locally-signalled process's own — then acts at
+        the next aligned boundary, costing at most ``every - 1`` extra
+        steps of the grace window; the alignment rule must be identical
+        on every process or the all-gather itself would lose a peer).  A
+        process that observed a peer's drain this way reports
+        ``signal_name == "peer"``.
+        """
+        local = self.should_stop()
+        if every > 1 and step % every:
+            return False
+        if broadcast_drain(local):
+            if not local:
+                self.signal_name = self.signal_name or "peer"
+                self._requested.set()
             return True
         return False
 

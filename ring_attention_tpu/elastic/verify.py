@@ -1,7 +1,7 @@
 """Machine-checked contracts for the elastic checkpoint layer.
 
 Run via ``tools/check_contracts.py --elastic`` (and the analysis
-self-run): CPU-only, virtual devices, no hardware.  Four checks, each
+self-run): CPU-only, virtual devices, no hardware.  Seven checks, each
 returning one-line violations like the memory/coverage suites:
 
 - **manifest round-trip** — a saved step's manifest re-reads through
@@ -18,12 +18,28 @@ returning one-line violations like the memory/coverage suites:
 - **commit protocol debris** — a dead writer's staging directory is
   invisible to ``all_steps`` and swept by the next save; a live writer's
   is left alone.
+
+Plus the multi-process rows (``multiprocess=True`` — the default for the
+CLI; they spawn real two-process ``jax.distributed`` clusters and cost
+tens of seconds, so the in-process test tier skips them):
+
+- **mp barrier** — two spawned processes rendezvous at
+  :func:`~.checkpoint.cross_process_barrier`; a missing peer converts to
+  a one-line :class:`~.checkpoint.BarrierTimeout` within the budget,
+  never a hang.
+- **mp commit round-trip** — a two-process cluster saves a sharded
+  state (each process writes only its shard group, process 0 commits the
+  manifest last); a ONE-process restore of that checkpoint is bit-exact
+  — the 2 -> 1 elastic path.
+- **mp restore grow** — a single-process save restores bit-exactly on a
+  spawned two-process cluster — the 1 -> 2 path.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import warnings
 
@@ -244,19 +260,287 @@ def check_commit_debris() -> list[str]:
     return violations
 
 
-def run_elastic_suite() -> list[tuple[str, list[str]]]:
+# ---------------------------------------------------------------------------
+# Multi-process rows: real two-process jax.distributed clusters
+# ---------------------------------------------------------------------------
+
+# deterministic state every worker and the parent can reconstruct without
+# communicating: the bit-exactness oracle of the mp round-trip rows
+def _mp_values():
+    import numpy as np
+
+    try:
+        from ml_dtypes import bfloat16
+    except ImportError:  # pragma: no cover - ml_dtypes rides with jax
+        bfloat16 = np.float32
+    return {
+        "x": np.arange(4 * 16, dtype=np.float32).reshape(4, 16),
+        "kv": (np.arange(2 * 16, dtype=np.float32) / 7).astype(
+            bfloat16
+        ).reshape(2, 16),
+        "w": np.arange(9, dtype=np.float32).reshape(3, 3),
+    }
+
+
+def _mp_place(mesh):
+    """The oracle values placed on ``mesh``: rank-2 leaves batch-over-
+    data x seq-over-ring (each process passes its local rows), ``w``
+    replicated."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import seq_sharding
+
+    values = _mp_values()
+    proc, nproc = jax.process_index(), jax.process_count()
+
+    def rows(full):
+        if nproc <= 1:
+            return full
+        per = full.shape[0] // nproc
+        return full[proc * per:(proc + 1) * per]
+
+    def place2d(full):
+        sharding = seq_sharding(mesh)
+        if nproc <= 1:
+            return jax.device_put(full, sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(rows(full))
+        )
+
+    state = {
+        "x": place2d(values["x"]),
+        "kv": place2d(values["kv"]),
+    }
+    if nproc <= 1:
+        state["w"] = jax.device_put(
+            values["w"], NamedSharding(mesh, P())
+        )
+    else:
+        state["w"] = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P()), values["w"]
+        )
+    return state
+
+
+# the worker bootstrap must set the virtual-device count BEFORE the
+# package (and therefore jax) imports — a ``python -c`` shim, not ``-m``
+_WORKER_BOOTSTRAP = (
+    "import os, sys;"
+    "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '')"
+    " + ' --xla_force_host_platform_device_count='"
+    " + os.environ.get('RING_ATTN_CHAOS_DEVICES', '2');"
+    "from ring_attention_tpu.elastic.verify import _main;"
+    "sys.exit(_main(sys.argv[1:]))"
+)
+
+
+def _spawn_cluster(mode: str, directory: str | None,
+                   *, timeout: float = 300.0) -> list:
+    """Two spawned verify workers joined into one jax.distributed
+    cluster (2 virtual devices each)."""
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    argv = [sys.executable, "-c", _WORKER_BOOTSTRAP, "--mp-worker", mode]
+    if directory is not None:
+        argv += ["--dir", directory]
+    worker = chaos.ChaosWorker(argv, cwd=repo, timeout=timeout)
+    return worker.run_cluster(processes=2, devices_per_process=2)
+
+
+def _cluster_violations(mode: str, results) -> list[str]:
+    out = []
+    for pid, r in enumerate(results):
+        if r.returncode != 0:
+            out.append(
+                f"{mode}: worker {pid} exited {r.returncode}: "
+                f"{(r.stdout or '')[-300:]}"
+            )
+        elif f"MPV-OK {mode} {pid}" not in (r.stdout or ""):
+            out.append(
+                f"{mode}: worker {pid} produced no MPV-OK line: "
+                f"{(r.stdout or '')[-300:]}"
+            )
+    return out
+
+
+def check_mp_barrier() -> list[str]:
+    """Two spawned processes rendezvous at the cross-process barrier, a
+    lonely waiter times out with a one-line BarrierTimeout inside its
+    budget, and both still exit cleanly."""
+    return _cluster_violations("barrier", _spawn_cluster("barrier", None))
+
+
+def check_mp_commit_roundtrip() -> list[str]:
+    """A two-process cluster saves; a ONE-process (this process) restore
+    is bit-exact — shard files from both processes, manifest committed by
+    process 0, 2 -> 1 re-scatter adds/loses nothing."""
+    violations: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        results = _spawn_cluster("save", tmp)
+        violations.extend(_cluster_violations("save", results))
+        if violations:
+            return violations
+        mgr = ElasticCheckpointManager(tmp)
+        manifest = mgr.latest_manifest()
+        if manifest is None:
+            return ["save: cluster committed no manifest"]
+        if manifest.get("process_count") != 2:
+            violations.append(
+                f"manifest process_count {manifest.get('process_count')}"
+                f" != 2"
+            )
+        step_dir = mgr._step_dir(manifest["step"])
+        shard_files = [n for n in os.listdir(step_dir)
+                       if n.startswith("shard_")]
+        if len(shard_files) < 4:
+            violations.append(
+                f"expected shard files from both processes' devices, "
+                f"found {sorted(shard_files)}"
+            )
+        if any(n.startswith("shards_p") for n in os.listdir(step_dir)):
+            violations.append("sidecar leaked into the committed step")
+        mesh = _mesh(4)
+        template = jax.tree_util.tree_map(
+            lambda x: x * 0, _mp_place(mesh)
+        )
+        restored = mgr.restore(template, mesh=mesh)
+        if restored is None:
+            return violations + ["restore of the cluster's save found nothing"]
+        for key, want in _mp_values().items():
+            got = jax.device_get(restored[0][key])
+            if not _bit_equal(got, want):
+                violations.append(
+                    f"2->1 restore: leaf {key} not bit-exact "
+                    f"(dtype {got.dtype} vs {want.dtype})"
+                )
+    return violations
+
+
+def check_mp_restore_grow() -> list[str]:
+    """This process saves; a spawned two-process cluster restores the
+    checkpoint bit-exactly — the 1 -> 2 path."""
+    violations: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        mesh = _mesh(2)
+        ElasticCheckpointManager(tmp, async_save=False).save(
+            7, _mp_place(mesh)
+        )
+        results = _spawn_cluster("restore", tmp)
+        violations.extend(_cluster_violations("restore", results))
+    return violations
+
+
+def run_elastic_suite(
+    *, multiprocess: bool = False
+) -> list[tuple[str, list[str]]]:
     """Every elastic contract as ``(name, violations)`` rows (the
-    check_contracts CLI table shape)."""
-    return [
+    check_contracts CLI table shape).  ``multiprocess=True`` appends the
+    spawned two-process rows (barrier semantics + both directions of the
+    cross-process-count round-trip) — the CLI default; the in-process
+    test tier and the analysis self-run skip them for time."""
+    checks = [
         ("elastic/manifest_roundtrip", check_manifest_roundtrip()),
         ("elastic/reshard_equals_direct", check_reshard_equals_direct()),
         ("elastic/corrupt_shard_fallback", check_corrupt_shard_falls_back()),
         ("elastic/commit_debris_sweep", check_commit_debris()),
     ]
+    if multiprocess:
+        checks += [
+            ("elastic/mp_barrier", check_mp_barrier()),
+            ("elastic/mp_commit_roundtrip", check_mp_commit_roundtrip()),
+            ("elastic/mp_restore_grow", check_mp_restore_grow()),
+        ]
+    return checks
 
 
-def _main() -> int:
-    checks = run_elastic_suite()
+# ---------------------------------------------------------------------------
+# The spawned worker (one process of a verify cluster)
+# ---------------------------------------------------------------------------
+
+
+def _mp_worker(mode: str, directory: str | None) -> int:
+    from .checkpoint import BarrierTimeout, cross_process_barrier
+
+    cluster = chaos.cluster_from_env()
+    assert cluster is not None, "worker needs RING_ATTN_CLUSTER"
+    pid, nproc, port = cluster
+
+    from ..parallel.mesh import create_mesh, initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc, process_id=pid,
+    )
+    if mode == "barrier":
+        cross_process_barrier("verify:meet", timeout_s=60)
+        # lonely waiter: only process 0 shows up; its wait must convert
+        # to BarrierTimeout inside the budget while the peer idles
+        if pid == 0:
+            try:
+                cross_process_barrier("verify:lonely", timeout_s=3)
+                print(  # ra: allow(RA006 suite CLI output)
+                    f"MPV-FAIL {mode} {pid}: lonely barrier did not "
+                    f"time out"
+                )
+                return 1
+            except BarrierTimeout:
+                pass
+        else:
+            import time
+
+            time.sleep(5)  # stay alive past the peer's timeout window
+        cross_process_barrier("verify:done", timeout_s=60)
+    else:
+        mesh = create_mesh(
+            dcn_data_size=nproc,
+            ring_size=len(jax.devices()) // nproc,
+        )
+        state = _mp_place(mesh)
+        mgr = ElasticCheckpointManager(
+            directory, async_save=False, barrier_timeout_s=60
+        )
+        if mode == "save":
+            mgr.save(5, state)
+        elif mode == "restore":
+            template = jax.tree_util.tree_map(lambda x: x * 0, state)
+            restored = mgr.restore(template, mesh=mesh)
+            assert restored is not None, "nothing to restore"
+            for key, ref in state.items():
+                got = restored[0][key]
+                for mine, theirs in zip(
+                    sorted(got.addressable_shards,
+                           key=lambda s: str(s.index)),
+                    sorted(ref.addressable_shards,
+                           key=lambda s: str(s.index)),
+                ):
+                    if not _bit_equal(
+                        jax.device_get(mine.data),
+                        jax.device_get(theirs.data),
+                    ):
+                        print(  # ra: allow(RA006 suite CLI output)
+                            f"MPV-FAIL {mode} {pid}: leaf {key} "
+                            f"shard {mine.index} differs"
+                        )
+                        return 1
+        else:
+            raise SystemExit(f"unknown --mp-worker mode {mode!r}")
+    print(f"MPV-OK {mode} {pid}")  # ra: allow(RA006 suite CLI output)
+    return 0
+
+
+def _main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mp-worker", default=None)
+    ap.add_argument("--dir", default=None)
+    ap.add_argument("--multiprocess", action="store_true")
+    args = ap.parse_args(argv)
+    if args.mp_worker:
+        return _mp_worker(args.mp_worker, args.dir)
+    checks = run_elastic_suite(multiprocess=args.multiprocess)
     bad = 0
     for name, violations in checks:
         status = "ok  " if not violations else "FAIL"

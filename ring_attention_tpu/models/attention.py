@@ -43,10 +43,10 @@ from ..ops.pallas_flash import (
 from ..ops.rotary import apply_rotary, hybrid_positions, ring_positions, rotary_freqs
 from ..parallel.hybrid import hybrid_attention
 from ..parallel.mesh import (
-    DATA_AXIS,
     RING_AXIS,
     SEQ_AXIS,
     ULYSSES_AXIS,
+    data_partition,
     is_factored,
     seq_partition,
     seq_world,
@@ -397,7 +397,7 @@ class RingAttention(nn.Module):
                 segment_ids = layout_permute(segment_ids, scheme, factor)
             x = lax.with_sharding_constraint(
                 x, NamedSharding(
-                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh), None)
+                    self.mesh, P(data_partition(self.mesh), seq_partition(self.mesh), None)
                 )
             )
 
@@ -478,7 +478,7 @@ class RingAttention(nn.Module):
         plain or factored sequence axes."""
         if segment_ids is None:
             return P()
-        return P(DATA_AXIS, seq_partition(self.mesh))
+        return P(data_partition(self.mesh), seq_partition(self.mesh))
 
     def _ring_leg(self, n_chunk: int):
         """Ring-leg knobs for chunks of length ``n_chunk`` — the whole
@@ -532,7 +532,7 @@ class RingAttention(nn.Module):
                 segment_ids=seg,
             )
 
-        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        qspec = P(data_partition(self.mesh), None, SEQ_AXIS, None)
         return compat.shard_map(
             core, mesh=self.mesh,
             in_specs=(qspec, qspec, qspec, self._seg_spec(segment_ids)),
@@ -562,8 +562,8 @@ class RingAttention(nn.Module):
                 segment_ids=seg,
             )
 
-        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
-        mspec = P(DATA_AXIS, SEQ_AXIS) if mask is not None else P()
+        qspec = P(data_partition(self.mesh), None, SEQ_AXIS, None)
+        mspec = P(data_partition(self.mesh), SEQ_AXIS) if mask is not None else P()
         return compat.shard_map(
             core, mesh=self.mesh,
             in_specs=(qspec, qspec, qspec, mspec, self._seg_spec(segment_ids)),
@@ -615,7 +615,7 @@ class RingAttention(nn.Module):
                 compute_dtype=self._compute_dtype(),
             )
 
-        qspec = P(DATA_AXIS, None, seq_partition(self.mesh), None)
+        qspec = P(data_partition(self.mesh), None, seq_partition(self.mesh), None)
         mspec = self._seg_spec(mask)
         return compat.shard_map(
             core,
@@ -655,8 +655,8 @@ class RingAttention(nn.Module):
                 compute_dtype=self._compute_dtype(),
             )
 
-        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
-        mspec = P(DATA_AXIS, SEQ_AXIS) if mask is not None else P()
+        qspec = P(data_partition(self.mesh), None, SEQ_AXIS, None)
+        mspec = P(data_partition(self.mesh), SEQ_AXIS) if mask is not None else P()
         return compat.shard_map(
             core,
             mesh=self.mesh,
@@ -901,7 +901,7 @@ class RingAttention(nn.Module):
                 hop_compression=self.ring_hop_compression,
             )
 
-        qspec = P(DATA_AXIS, None, SEQ_AXIS, None)
+        qspec = P(data_partition(self.mesh), None, SEQ_AXIS, None)
         out = compat.shard_map(
             core,
             mesh=self.mesh,
@@ -972,10 +972,10 @@ class RingAttention(nn.Module):
                 )
             return out, cache_k, cache_v
 
-        cspec = P(DATA_AXIS, None, SEQ_AXIS, None)
-        sspec = P(DATA_AXIS, None, SEQ_AXIS)
+        cspec = P(data_partition(self.mesh), None, SEQ_AXIS, None)
+        sspec = P(data_partition(self.mesh), None, SEQ_AXIS)
         cache_spec = (cspec, sspec) if quant else cspec
-        rep = P(DATA_AXIS, None, None, None)
+        rep = P(data_partition(self.mesh), None, None, None)
         return compat.shard_map(
             core,
             mesh=self.mesh,

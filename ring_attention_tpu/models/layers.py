@@ -115,12 +115,13 @@ class FeedForward(nn.Module):
             # keep the shard axis on the sequence mesh axes through the
             # scan: without the constraint the partitioner is free to
             # gather the whole sequence onto every device
-            from ..parallel.mesh import DATA_AXIS, seq_partition
+            from ..parallel.mesh import data_partition, seq_partition
 
             xs = lax.with_sharding_constraint(
                 xs, NamedSharding(
                     self.mesh,
-                    P(None, DATA_AXIS, seq_partition(self.mesh), None, None),
+                    P(None, data_partition(self.mesh),
+                      seq_partition(self.mesh), None, None),
                 )
             )
 
@@ -140,11 +141,12 @@ class FeedForward(nn.Module):
             out = out[:, :, :n_local]
         out = out.reshape(b, n, d)
         if self.mesh is not None and shards > 1:
-            from ..parallel.mesh import DATA_AXIS, seq_partition
+            from ..parallel.mesh import data_partition, seq_partition
 
             out = lax.with_sharding_constraint(
                 out, NamedSharding(
-                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh), None)
+                    self.mesh, P(data_partition(self.mesh),
+                                 seq_partition(self.mesh), None)
                 )
             )
         return out

@@ -30,9 +30,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import PAD_SEGMENT_ID
 from ..parallel.mesh import (
-    DATA_AXIS,
     SEQ_AXIS,
     ULYSSES_AXIS,
+    data_partition,
     is_factored,
     seq_partition,
     seq_world,
@@ -367,7 +367,7 @@ class RingTransformer(nn.Module):
             tokens = layout_permute(tokens, scheme, factor)
             tokens = lax.with_sharding_constraint(
                 tokens, NamedSharding(
-                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh))
+                    self.mesh, P(data_partition(self.mesh), seq_partition(self.mesh))
                 )
             )
             if mask is not None:
@@ -385,7 +385,7 @@ class RingTransformer(nn.Module):
         if ring > 1 and self.auto_shard:
             x = lax.with_sharding_constraint(
                 x, NamedSharding(
-                    self.mesh, P(DATA_AXIS, seq_partition(self.mesh), None)
+                    self.mesh, P(data_partition(self.mesh), seq_partition(self.mesh), None)
                 )
             )
 
@@ -520,15 +520,15 @@ class RingTransformer(nn.Module):
                 if ring > 1:
                     entry = (
                         jax.device_put(entry[0], NamedSharding(
-                            self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None))),
+                            self.mesh, P(data_partition(self.mesh), None, SEQ_AXIS, None))),
                         jax.device_put(entry[1], NamedSharding(
-                            self.mesh, P(DATA_AXIS, None, SEQ_AXIS))),
+                            self.mesh, P(data_partition(self.mesh), None, SEQ_AXIS))),
                     )
                 return entry
             entry = jnp.zeros(shape, dtype)
             if ring > 1:
                 entry = jax.device_put(entry, NamedSharding(
-                    self.mesh, P(DATA_AXIS, None, SEQ_AXIS, None)))
+                    self.mesh, P(data_partition(self.mesh), None, SEQ_AXIS, None)))
             return entry
 
         sizes = [

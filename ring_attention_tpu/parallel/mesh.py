@@ -23,6 +23,14 @@ SEQ_AXIS = "seq"
 # over ``ulysses`` reassembles exactly that chunk (parallel/hybrid.py)
 ULYSSES_AXIS = "ulysses"
 RING_AXIS = "ring"
+# hierarchical (pod-scale) outermost axis: pure data parallelism over the
+# slow DCN links between slices/processes.  The sequence axes (ring /
+# ulysses) must live strictly INSIDE one dcn_data group — sequence
+# parallelism is placed on the physical topology (TASP, arXiv 2509.26541):
+# per-hop ppermutes and bandwidth-hungry all-to-alls ride ICI, only the
+# once-per-step gradient all-reduce crosses DCN.  Proven from optimized
+# HLO by ``analysis/contracts.py::check_dcn_isolation``.
+DCN_DATA_AXIS = "dcn_data"
 
 
 def _snake_coords(dims: tuple[int, ...]) -> list[tuple[int, ...]]:
@@ -79,12 +87,15 @@ def create_mesh(
     data_size: int | None = None,
     *,
     ulysses_size: int | None = None,
+    dcn_data_size: int | None = None,
     devices: list | None = None,
     ring_order: str = "auto",
 ) -> Mesh:
     """Build a ``(data, seq)`` mesh — or ``(data, ring, ulysses)`` when
     ``ulysses_size`` factors the sequence axis for hybrid 2-D sequence
-    parallelism (``sequence_parallel="hybrid"``).
+    parallelism (``sequence_parallel="hybrid"``), or a hierarchical
+    ``(dcn_data, data, ...)`` mesh when ``dcn_data_size`` adds the
+    pod-scale DCN level.
 
     ``ring_size`` defaults to all devices (one big ring); ``data_size``
     defaults to ``n_devices // ring_size`` — the reference's
@@ -112,6 +123,22 @@ def create_mesh(
     lands on the closest-connected device groups and the ring's per-hop
     ppermute rides the next tier out — the TASP/TokenRing
     collective-to-link-tier matching (PAPERS.md).
+
+    ``dcn_data_size=D`` (default off) prepends the pod-scale ``dcn_data``
+    axis — the OUTERMOST (slowest-varying) dimension, mapping onto the
+    DCN links between slices/processes: the mesh becomes
+    ``(dcn_data, data, seq)`` or ``(dcn_data, data, ring, ulysses)``,
+    with ``data_size`` / ``ring_size`` / ``ulysses_size`` now describing
+    ONE dcn group of ``n_devices / D``.  The placement contract (the
+    whole point of the hierarchy) is that every sequence-parallel group —
+    each ring and each ulysses all-to-all set — sits strictly inside one
+    dcn group; under ``jax.distributed`` each group must additionally sit
+    inside one *process* (rings must never hop over DCN).  The
+    construction validates that and raises a one-line diagnostic when the
+    device order cannot honor it; ``analysis/contracts.py::
+    check_dcn_isolation`` proves the resulting collective placement from
+    optimized HLO.  Pass ``dcn_data_size=jax.process_count()`` on a
+    multi-host pod.
     """
     if ring_order not in ("auto", "flat"):
         raise ValueError(
@@ -121,6 +148,32 @@ def create_mesh(
     explicit = devices is not None
     devices = devices if explicit else jax.devices()
     n = len(devices)
+    dcn = int(dcn_data_size or 1)
+    if dcn > 1:
+        if n % dcn:
+            raise ValueError(
+                f"create_mesh: dcn_data_size {dcn} must divide "
+                f"{n} devices"
+            )
+        # the inner (per-dcn-group) world: data/ring/ulysses factor THIS
+        inner = create_mesh(
+            ring_size, data_size, ulysses_size=ulysses_size,
+            devices=list(devices)[:n // dcn], ring_order=ring_order,
+        )
+        shape = (dcn, *inner.devices.shape)
+        axes = (DCN_DATA_AXIS, *inner.axis_names)
+        arr = np.asarray(devices).reshape(shape)  # ra: allow(RA009 host-side device-object array for Mesh construction)
+        # within each dcn group, reuse the inner (possibly topology-aware)
+        # ordering group by group so rings still snake their slice
+        for g in range(dcn):
+            sub = create_mesh(
+                ring_size, data_size, ulysses_size=ulysses_size,
+                devices=list(np.asarray(arr[g]).reshape(-1)),  # ra: allow(RA009 host-side device-object array for Mesh construction)
+                ring_order=ring_order,
+            )
+            arr[g] = sub.devices
+        _validate_dcn_grouping(arr, axes)
+        return Mesh(arr, axes)
     if ulysses_size is not None and ulysses_size > 1:
         u = ulysses_size
         assert n % u == 0, f"ulysses_size {u} must divide {n} devices"
@@ -170,9 +223,60 @@ def create_mesh(
     return Mesh(arr, axes)
 
 
+def _validate_dcn_grouping(arr: np.ndarray, axes: tuple[str, ...]) -> None:
+    """The hierarchical placement contract: every sequence-parallel group
+    (the trailing ring/ulysses/seq dims of one ``(dcn, data)`` cell) must
+    sit inside ONE process — a ring whose hops cross the DCN boundary is
+    exactly the straggler topology the dcn axis exists to forbid.  Only
+    meaningful under ``jax.distributed``; single-process (virtual-device)
+    meshes always pass."""
+    if jax.process_count() <= 1:
+        return
+    data_i = axes.index(DATA_AXIS)
+    lead = arr.shape[: data_i + 1]
+    cells = arr.reshape(int(np.prod(lead)), -1)  # ra: allow(RA009 host-side device-topology math on python ints)
+    for cell, devs in enumerate(cells):
+        procs = {getattr(d, "process_index", 0) for d in devs}
+        if len(procs) > 1:
+            coords = np.unravel_index(cell, lead)  # ra: allow(RA009 host-side device-topology math on python ints)
+            raise ValueError(
+                f"create_mesh: sequence-parallel group at "
+                f"{dict(zip(axes[:data_i + 1], map(int, coords)))} spans "
+                f"processes {sorted(procs)} — rings/ulysses groups must "
+                f"live inside one process (set dcn_data_size="
+                f"jax.process_count() and size data/ring/ulysses to one "
+                f"process's devices)"
+            )
+
+
 def is_factored(mesh: Mesh) -> bool:
     """True when the mesh factors the sequence axis (hybrid Ulysses x Ring)."""
     return RING_AXIS in mesh.shape
+
+
+def has_dcn(mesh: Mesh | None) -> bool:
+    """True when the mesh carries the pod-scale ``dcn_data`` level."""
+    return mesh is not None and DCN_DATA_AXIS in mesh.shape
+
+
+def data_partition(mesh: Mesh | None):
+    """PartitionSpec entry for the batch dimension: ``"data"`` on flat
+    meshes, ``("dcn_data", "data")`` on hierarchical ones — the batch
+    shards over BOTH data-parallel tiers, so per-step traffic over the
+    slow axis stays the one gradient all-reduce."""
+    if has_dcn(mesh):
+        return (DCN_DATA_AXIS, DATA_AXIS)
+    return DATA_AXIS
+
+
+def data_world(mesh: Mesh | None) -> int:
+    """Total data-parallel degree (both tiers of a hierarchical mesh)."""
+    if mesh is None:
+        return 1
+    size = int(mesh.shape.get(DATA_AXIS, 1))
+    if has_dcn(mesh):
+        size *= int(mesh.shape[DCN_DATA_AXIS])
+    return size
 
 
 def seq_axes(mesh: Mesh) -> tuple[str, ...]:
@@ -218,19 +322,23 @@ def mesh_descriptor(mesh: Mesh | None) -> dict | None:
 
 
 def remesh_plan(
-    old: dict | None, n_devices: int
+    old: dict | None, n_devices: int, *, dcn_data_size: int | None = None
 ) -> tuple[dict, list[str]]:
     """Plan a mesh factoring for ``n_devices`` given a checkpoint's old
     :func:`mesh_descriptor` — the elastic-resume re-mesh rule.
 
     Preference order (each preserved factor keeps resume semantics
-    closest to the old run): keep ``data`` and ``ulysses`` exactly when
-    they still divide the new world, and absorb ALL growth/shrink into
-    the ``ring``/``seq`` axis (sequence shards are what the resharded
-    loader re-scatters anyway); when a preserved factor no longer
-    divides, fall back to its gcd with the world.  Returns
-    ``(create_mesh_kwargs, diagnostics)`` where every decision that
-    changed something is one human-readable line — the resume banner.
+    closest to the old run): keep ``dcn_data``, ``data`` and ``ulysses``
+    exactly when they still divide the new world, and absorb ALL
+    growth/shrink into the ``ring``/``seq`` axis (sequence shards are
+    what the resharded loader re-scatters anyway); when a preserved
+    factor no longer divides, fall back to its gcd with the world.
+    ``dcn_data_size`` overrides the preserved dcn level — pass the
+    CURRENT ``jax.process_count()`` so a job that lost a host re-plans
+    its DCN tier to the surviving cluster (1 drops the axis entirely).
+    Returns ``(create_mesh_kwargs, diagnostics)`` where every decision
+    that changed something is one human-readable line — the resume
+    banner.
     """
     from math import gcd
 
@@ -242,24 +350,55 @@ def remesh_plan(
             f"re-mesh: no mesh recorded in the checkpoint; defaulting to "
             f"one ring of {n_devices}"
         )
-        return {"ring_size": n_devices}, diags
+        plan: dict = {"ring_size": n_devices}
+        if dcn_data_size and dcn_data_size > 1:
+            if n_devices % dcn_data_size:
+                raise ValueError(
+                    f"remesh_plan: dcn_data_size {dcn_data_size} does not "
+                    f"divide the {n_devices}-device world"
+                )
+            plan = {"ring_size": n_devices // dcn_data_size,
+                    "dcn_data_size": dcn_data_size}
+        return plan, diags
     sizes = dict(zip(old.get("axes", []), old.get("shape", [])))
     old_world = 1
     for s in sizes.values():
         old_world *= int(s)
+    dcn = int(sizes.get(DCN_DATA_AXIS, 1))
     data = int(sizes.get(DATA_AXIS, 1))
     ulysses = int(sizes.get(ULYSSES_AXIS, 1))
     ring = int(sizes.get(RING_AXIS, sizes.get(SEQ_AXIS, 1)))
     if old_world != n_devices:
         diags.append(f"re-mesh: world {old_world} -> {n_devices}")
-    if n_devices % data != 0:
-        new_data = gcd(data, n_devices)
+    if dcn_data_size is not None:
+        new_dcn = int(dcn_data_size)
+        if n_devices % max(new_dcn, 1):
+            raise ValueError(
+                f"remesh_plan: dcn_data_size {new_dcn} does not divide "
+                f"the {n_devices}-device world"
+            )
+        if new_dcn != dcn:
+            diags.append(
+                f"re-mesh: dcn_data {dcn} -> {new_dcn} (process count "
+                f"changed)"
+            )
+        dcn = max(new_dcn, 1)
+    elif n_devices % dcn != 0:
+        new_dcn = gcd(dcn, n_devices)
         diags.append(
-            f"re-mesh: data {data} does not divide world {n_devices}; "
+            f"re-mesh: dcn_data {dcn} does not divide world {n_devices}; "
+            f"shrinking to gcd {new_dcn}"
+        )
+        dcn = new_dcn
+    rest = n_devices // dcn
+    if rest % data != 0:
+        new_data = gcd(data, rest)
+        diags.append(
+            f"re-mesh: data {data} does not divide world {rest}; "
             f"shrinking to gcd {new_data}"
         )
         data = new_data
-    rest = n_devices // data
+    rest = rest // data
     if rest % ulysses != 0:
         new_u = gcd(ulysses, rest)
         diags.append(
@@ -273,6 +412,8 @@ def remesh_plan(
     kwargs: dict = {"ring_size": new_ring, "data_size": data}
     if ulysses > 1:
         kwargs["ulysses_size"] = ulysses
+    if dcn > 1:
+        kwargs["dcn_data_size"] = dcn
     return kwargs, diags
 
 
@@ -299,24 +440,74 @@ def validate_seq_len(seq_len: int, mesh: Mesh | None) -> None:
         )
 
 
-def initialize_multihost(**kwargs) -> None:
+def initialize_multihost(
+    *, attempts: int = 3, backoff: float = 1.0, **kwargs
+) -> None:
     """Join a multi-host (multi-process) TPU job before building meshes.
 
-    Thin passthrough to ``jax.distributed.initialize`` — on TPU pods the
-    coordinator/process-count/process-id are discovered from the
-    environment automatically, so a bare call suffices.  After this,
-    ``jax.devices()`` is the *global* device list and ``create_mesh`` spans
-    the whole slice (collectives ride ICI within a slice and DCN across,
-    scheduled by XLA — the analogue of the reference's NCCL multi-node
-    process groups, SURVEY §2.3).
+    ``jax.distributed.initialize`` behind the shared retry ladder
+    (``utils/resilience.with_retries``) — on a real pod the workers race
+    the coordinator to startup, and "coordinator not yet listening" is a
+    transient that deserves ``attempts`` backed-off retries, not a crash.
+    Exhaustion fires the resilience failure listeners (an installed
+    FlightRecorder dumps the incident) and raises ONE line naming the
+    coordinator address, so a dead coordinator is a readable diagnosis
+    instead of a grpc traceback.
+
+    On TPU pods the coordinator/process-count/process-id are discovered
+    from the environment automatically, so a bare call suffices.  After
+    this, ``jax.devices()`` is the *global* device list and
+    ``create_mesh(dcn_data_size=jax.process_count())`` builds the
+    hierarchical mesh whose rings never cross DCN (the analogue of the
+    reference's NCCL multi-node process groups, SURVEY §2.3).
     """
-    jax.distributed.initialize(**kwargs)
+    from ..utils.resilience import RetryError, with_retries
+
+    import os
+
+    # CPU clusters (the two-process test harness, dev boxes) need the
+    # gloo collectives backend enabled BEFORE the first computation —
+    # without it every cross-process jit dies with "Multiprocess
+    # computations aren't implemented on the CPU backend".  Set it only
+    # when the platform is (or defaults to) cpu; builds without the
+    # option degrade gracefully like every compat shim.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
+        jax.config.jax_platforms or ""
+    ).startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax: no such option
+            pass
+
+    def initialize_multihost_join() -> None:
+        jax.distributed.initialize(**kwargs)
+
+    try:
+        with_retries(
+            initialize_multihost_join,
+            max_attempts=attempts, backoff=backoff,
+        )
+    except RetryError as e:
+        import os
+
+        coordinator = (
+            kwargs.get("coordinator_address")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or "<env-discovered>"
+        )
+        raise RuntimeError(
+            f"initialize_multihost: could not join the jax cluster at "
+            f"coordinator {coordinator} after {attempts} attempts "
+            f"(last: {type(e.last).__name__}: {e.last}) — is the "
+            f"coordinator process up and reachable?"
+        ) from e
 
 
 def seq_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for ``(b, n, ...)`` activations: batch over data, seq over
-    the ring — or over ``(ring, ulysses)`` on a factored (hybrid) mesh."""
-    return NamedSharding(mesh, P(DATA_AXIS, seq_partition(mesh)))
+    """Sharding for ``(b, n, ...)`` activations: batch over the data
+    tier(s) (``(dcn_data, data)`` on a hierarchical mesh), seq over the
+    ring — or over ``(ring, ulysses)`` on a factored (hybrid) mesh."""
+    return NamedSharding(mesh, P(data_partition(mesh), seq_partition(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -349,7 +540,7 @@ def shard_batch(batch, mesh: Mesh):
         if x.ndim >= 2:
             sharding = seq_sharding(mesh)
         elif x.ndim == 1:
-            sharding = NamedSharding(mesh, P(DATA_AXIS))
+            sharding = NamedSharding(mesh, P(data_partition(mesh)))
         else:
             sharding = replicated(mesh)
         if jax.process_count() == 1:

@@ -146,10 +146,13 @@ class CheckpointManager:
     warning) falls back to the newest older step on any integrity failure.
 
     This manager targets the single-process case (CPU mesh / one-host TPU:
-    every device's shards are addressable).  Multi-host jobs should use
-    :func:`save_checkpoint` / :func:`restore_checkpoint` (Orbax coordinates
-    cross-host writes) — the manager refuses ``jax.process_count() > 1``
-    rather than writing per-host files that look like full checkpoints.
+    every device's shards are addressable) — it gathers every leaf to one
+    host.  Multi-host jobs use
+    :class:`~ring_attention_tpu.elastic.ElasticCheckpointManager`, whose
+    multi-process protocol writes one shard group per process and commits
+    the manifest behind a cross-process barrier (docs/resilience.md) —
+    this manager refuses ``jax.process_count() > 1`` rather than writing
+    per-host files that look like full checkpoints.
     """
 
     def __init__(
@@ -268,8 +271,11 @@ class CheckpointManager:
 
         if jax.process_count() > 1:
             raise RuntimeError(
-                "CheckpointManager is single-process; use save_checkpoint "
-                "(Orbax) for multi-host jobs"
+                "CheckpointManager is single-process (it gathers every "
+                "leaf to one host); multi-host jobs use "
+                "ring_attention_tpu.elastic.ElasticCheckpointManager — "
+                "each process writes its own shard group and process 0 "
+                "commits the manifest behind a cross-process barrier"
             )
         leaves, treedef = _state_leaves(state)
         with self._dirlock.locked(timeout=self.lock_timeout):

@@ -802,6 +802,7 @@ def train_memory_estimate(
     loss_chunk_size: int | None = None,
     remat_policy: str | None = None,
     offload_opt_state: bool = False,
+    shard_opt_data: int = 1,
     seq_shards: int = 1,
     compute_dtype: str | None = None,
 ) -> dict[str, Any]:
@@ -814,7 +815,10 @@ def train_memory_estimate(
     hardware window.  Terms (per chip, sequence split ``seq_shards``-ways):
 
     - params: weights (model dtype) + Adam moments (2x f32) + f32 grads,
-      moments dropped from HBM when ``offload_opt_state``;
+      moments dropped from HBM when ``offload_opt_state``, divided
+      ``shard_opt_data``-ways when ZeRO-1 sharding spreads them over the
+      data axes (``make_train_step(shard_opt_state=True)``; pass the
+      full data-parallel world — both tiers of a hierarchical mesh);
     - saved per layer: the two rematted block inputs ``2*(b, n, dim)``,
       plus the policy's keeps (``save_attn``: ``(b, n, dim)`` out +
       f32 ``(b, h, n)`` lse; ``offload_attn`` keeps those on host);
@@ -829,7 +833,10 @@ def train_memory_estimate(
     act = dtype_bytes
 
     params_bytes = n_params * act + n_params * 4  # weights + f32 grads
-    opt_bytes = 0 if offload_opt_state else 2 * n_params * 4
+    opt_bytes = (
+        0 if offload_opt_state
+        else 2 * n_params * 4 // max(int(shard_opt_data), 1)
+    )
     saved = 2 * b * n * dim * act  # the two block inputs per layer
     policy = remat_policy or "nothing_saveable"
     if policy in ("save_attn", "save_attn_and_ffn_inputs"):

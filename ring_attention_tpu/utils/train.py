@@ -60,6 +60,8 @@ def make_train_step(
     collect_metrics: bool = False,
     offload_opt_state: bool = False,
     offload_mesh: Mesh | None = None,
+    shard_opt_state: bool = False,
+    shard_mesh: Mesh | None = None,
     on_step_end: Callable[..., None] | None = None,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
@@ -124,6 +126,23 @@ def make_train_step(
       metric derives from values the step already computes, so
       instrumentation adds no collectives to the compiled program
       (pinned by ``tests/test_telemetry.py``).
+    - ``shard_opt_state=True`` — ZeRO-1 optimizer-state sharding
+      (``shard_mesh`` required): every eligible opt-state leaf gets a
+      data-axis ``with_sharding_constraint`` inside the step (both tiers
+      — ``("dcn_data", "data")`` — on a hierarchical mesh), via
+      :func:`shard_optimizer_state`.  Adam's two model-sized f32 moment
+      buffers then cost ``1/data_world`` HBM per chip; gradients and
+      parameters stay replicated, XLA inserts the gather around the
+      update.  Seed the loop the same way::
+
+          opt_state = shard_optimizer_state(opt.init(params), mesh)
+
+      Composes with ``offload_opt_state`` (constrain FIRST, then park on
+      host — a sharded state stays sharded in host memory) and with the
+      elastic checkpoint manager (each process saves only its shard
+      group of the now-sharded moments; restore re-scatters).  Audited
+      by ``analysis/recompile.audit_donation`` / ``audit_host_offload``
+      and pinned in ``tests/test_elastic.py``.
     - ``on_step_end`` — a HOST callback ``on_step_end(outputs)`` invoked
       after every step call with the step's full output tuple.  This is
       the hook the elastic runtime hangs off (``elastic/``): the async
@@ -147,6 +166,11 @@ def make_train_step(
     if clip_grad_norm is not None and clip_grad_norm <= 0:
         raise ValueError(
             f"make_train_step: clip_grad_norm must be > 0, got {clip_grad_norm}"
+        )
+    if shard_opt_state and shard_mesh is None:
+        raise ValueError(
+            "make_train_step: shard_opt_state=True needs shard_mesh= "
+            "(the mesh whose data axis the optimizer state shards over)"
         )
     grad_fn = jax.value_and_grad(loss_fn)
 
@@ -208,9 +232,17 @@ def make_train_step(
         return new_params, new_opt_state, loss, gnorm
 
     def place_opt(opt_state):
-        # host offload runs LAST in the step (after any skip-guard select)
-        # so the returned buffers actually land — and stay — in host
-        # memory; a no-op on backends without a host space
+        # placement runs LAST in the step (after any skip-guard select):
+        # ZeRO-1 data-axis constraint first (per-program, so the
+        # partitioner keeps the moments sharded), then the host offload
+        # — a sharded state stays sharded in host memory; both are
+        # no-ops when their knob is off
+        if shard_opt_state:
+            from ..parallel.mesh import data_partition
+
+            opt_state = shard_optimizer_state(
+                opt_state, shard_mesh, axis=data_partition(shard_mesh)
+            )
         if not offload_opt_state:
             return opt_state
         from . import compat
@@ -323,16 +355,21 @@ def make_train_step(
 
 
 def shard_optimizer_state(
-    opt_state: Any, mesh: Mesh, axis: str = "data"
+    opt_state: Any, mesh: Mesh, axis: str | tuple = "data"
 ) -> Any:
-    """ZeRO-1-style optimizer-state sharding over one mesh axis.
+    """ZeRO-1-style optimizer-state sharding over one or more mesh axes.
 
     Every float array in ``opt_state`` whose leading dimension divides by
     the axis size gets ``with_sharding_constraint(P(axis))`` on that
     dimension; everything else (step counters, odd shapes) stays
-    replicated.  Apply once to the freshly-initialized state AND inside
-    the jitted step to the updated state (constraints guide the
-    partitioner per-program), e.g.::
+    replicated.  ``axis`` may be a tuple of mesh axis names — on a
+    hierarchical mesh pass ``("dcn_data", "data")`` (or just
+    :func:`~ring_attention_tpu.parallel.mesh.data_partition`) so the
+    moments spread over the FULL data-parallel world, both tiers.  Apply
+    once to the freshly-initialized state AND inside the jitted step to
+    the updated state (constraints guide the partitioner per-program) —
+    or build the step with ``make_train_step(shard_opt_state=True,
+    shard_mesh=mesh)``, which does the in-step half for you::
 
         opt_state = shard_optimizer_state(opt.init(params), mesh)
 
@@ -347,7 +384,11 @@ def shard_optimizer_state(
     and parameters stay replicated (the reference has no equivalent — its
     DDP replicates optimizer state per rank).
     """
-    size = mesh.shape[axis]
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    entry = tuple(axes) if len(axes) > 1 else axes[0]
 
     def constrain(x):
         if (
@@ -357,7 +398,7 @@ def shard_optimizer_state(
             and x.shape[0] % size == 0
             and x.shape[0] > 0
         ):
-            spec = P(axis, *([None] * (x.ndim - 1)))
+            spec = P(entry, *([None] * (x.ndim - 1)))
             return lax.with_sharding_constraint(
                 x, NamedSharding(mesh, spec)
             )

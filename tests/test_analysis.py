@@ -666,3 +666,64 @@ def test_collective_fingerprint_shape(devices):
     cheap enough to ride along every bench round."""
     fp = contracts.collective_fingerprint(strategies=("ring",))
     assert fp == {"ring": {"ppermute": 7}, "contract_ok": True}
+
+
+# ----------------------------------------------------------------------
+# DCN isolation: the pod-scale placement contract (PR 15)
+# ----------------------------------------------------------------------
+
+
+def test_contract_dcn_isolation(devices):
+    """The hierarchical-mesh rows: ring and hybrid compiled over a
+    ``(dcn_data, ...)`` mesh hold their ordinary collective contracts
+    AND provably issue zero sequence-parallel collectives over the dcn
+    axis — from optimized HLO and the jaxpr walk, fwd and fwdbwd."""
+    _assert_ok(contracts.check_dcn_isolation())
+
+
+def test_dcn_isolation_negative_toy(devices):
+    """A deliberate collective OVER the dcn axis must be flagged by both
+    halves of the proof — the HLO permute-pair scan and the traced
+    axis-name walk — each with a one-line diagnostic naming the rule."""
+    from ring_attention_tpu.parallel.mesh import DCN_DATA_AXIS, create_mesh
+
+    mesh = create_mesh(dcn_data_size=2, ring_size=4)
+
+    def bad(x):
+        # a "ring hop" straight over the slow inter-slice links
+        return lax.ppermute(
+            x, DCN_DATA_AXIS, [(i, (i + 1) % 2) for i in range(2)]
+        )
+
+    fn = compat.shard_map(
+        bad, mesh=mesh, in_specs=P(DCN_DATA_AXIS),
+        out_specs=P(DCN_DATA_AXIS),
+    )
+    x = jnp.arange(8.0)
+    txt = compat.jit(fn).lower(x).compile().as_text()
+    violations = contracts.hlo_dcn_isolation(
+        txt, tuple(mesh.shape.values()), list(mesh.shape.keys())
+    )
+    assert violations, "cross-dcn permute escaped the HLO scan"
+    assert all("dcn-isolation" in v for v in violations)
+    axes_by_prim = contracts.jaxpr_collective_axis_names(
+        jax.make_jaxpr(fn)(x)
+    )
+    assert DCN_DATA_AXIS in axes_by_prim.get("ppermute", set())
+    # a mesh with no dcn axis has nothing to prove — reported, not passed
+    flat = create_mesh(ring_size=8)
+    note = contracts.hlo_dcn_isolation(
+        txt, tuple(flat.shape.values()), list(flat.shape.keys())
+    )
+    assert note and "nothing to prove" in note[0]
+
+
+def test_dcn_collective_fingerprint_deterministic(devices):
+    """The bench phase-0e payload: per-row fwd collective counts over
+    the hierarchical mesh + the machine-checked verdict, deterministic
+    across calls (it rides the exact perf-gate family)."""
+    fp = contracts.dcn_collective_fingerprint()
+    assert fp["dcn_ok"] is True
+    assert fp["ring_dcn"] == {"ppermute": 3}
+    assert "hybrid_dcn" in fp
+    assert contracts.dcn_collective_fingerprint() == fp

@@ -404,6 +404,66 @@ def test_remesh_plan_preserves_data_and_ulysses():
     assert any("does not divide" in d for d in diags)
 
 
+def test_remesh_plan_dcn_tier():
+    """The pod-scale re-mesh rules: the dcn tier tracks the CURRENT
+    process count when given (dropping to 1 removes the axis), is
+    preserved-while-dividing otherwise, and divisibility violations are
+    one-line errors."""
+    from ring_attention_tpu.parallel import remesh_plan
+
+    old = {"axes": ["dcn_data", "data", "seq"], "shape": [2, 1, 4]}
+    # lost a host: re-plan at 1 process, half the world — dcn drops
+    plan, diags = remesh_plan(old, 4, dcn_data_size=1)
+    assert plan == {"ring_size": 4, "data_size": 1}
+    assert any("dcn_data 2 -> 1 (process count changed)" in d
+               for d in diags)
+    # same cluster shape: same factoring, no diagnostics
+    plan, diags = remesh_plan(old, 8, dcn_data_size=2)
+    assert plan == {"ring_size": 4, "data_size": 1, "dcn_data_size": 2}
+    assert diags == []
+    # no process count given: dcn preserved while it divides
+    plan, _ = remesh_plan(old, 16)
+    assert plan["dcn_data_size"] == 2 and plan["ring_size"] == 8
+    # grew the pod: 1 -> 2 processes over a flat checkpoint
+    plan, diags = remesh_plan(
+        {"axes": ["data", "seq"], "shape": [1, 4]}, 4, dcn_data_size=2
+    )
+    assert plan == {"ring_size": 2, "data_size": 1, "dcn_data_size": 2}
+    assert any("dcn_data 1 -> 2" in d for d in diags)
+    # indivisible process count is a one-line error
+    with pytest.raises(ValueError, match="dcn_data_size 3"):
+        remesh_plan(old, 8, dcn_data_size=3)
+
+
+def test_create_mesh_dcn_shape_and_validation(devices):
+    """The hierarchical mesh: dcn_data outermost, inner axes unchanged,
+    divisibility violations one-line."""
+    from ring_attention_tpu.parallel import (
+        create_mesh,
+        data_partition,
+        data_world,
+        has_dcn,
+        mesh_descriptor,
+        seq_world,
+    )
+
+    mesh = create_mesh(dcn_data_size=2, ring_size=2, data_size=2)
+    assert tuple(mesh.axis_names) == ("dcn_data", "data", "seq")
+    assert dict(mesh.shape) == {"dcn_data": 2, "data": 2, "seq": 2}
+    assert has_dcn(mesh) and data_partition(mesh) == ("dcn_data", "data")
+    assert data_world(mesh) == 4 and seq_world(mesh) == 2
+    assert mesh_descriptor(mesh)["axes"] == ["dcn_data", "data", "seq"]
+    factored = create_mesh(dcn_data_size=2, ring_size=2, ulysses_size=2)
+    assert tuple(factored.axis_names) == (
+        "dcn_data", "data", "ring", "ulysses"
+    )
+    # flat meshes are unchanged by the new axis machinery
+    flat = _mesh(4)
+    assert not has_dcn(flat) and data_partition(flat) == "data"
+    with pytest.raises(ValueError, match="dcn_data_size 3"):
+        create_mesh(dcn_data_size=3)
+
+
 def test_validate_seq_len_one_line_diagnostic(devices):
     from ring_attention_tpu.parallel import validate_seq_len
 
@@ -574,3 +634,171 @@ def test_bench_probe_healthy_path_still_passes(monkeypatch):
     monkeypatch.setenv("BENCH_PROBE_DEADLINE_S", "120")
     monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "1")
     assert bench._run_probe() == {"ok": True}
+
+
+# ----------------------------------------------------------------------
+# Watchdog: a wedged step becomes a bounded abort (in-process half; the
+# spawned-cluster pin lives in tests/test_multihost.py)
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_fires_after_deadline_with_incident(tmp_path):
+    """A heartbeat that goes stale past the deadline fires the abort
+    exactly once, with the stalled step named in the message AND in a
+    ``watchdog_abort`` flight incident — the conversion that turns an
+    eternal hang into a restartable death."""
+    from ring_attention_tpu.elastic import Watchdog
+    from ring_attention_tpu.utils import FlightRecorder, read_flight_dump
+
+    recorder = FlightRecorder(str(tmp_path), window=4)
+    fired = []
+    dog = Watchdog(0.3, recorder=recorder, abort=fired.append,
+                   poll_s=0.05)
+    with dog:
+        dog.beat(7)
+        deadline = time.monotonic() + 10
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert dog.fired and len(fired) == 1, fired
+    assert "watchdog: no heartbeat" in fired[0]
+    assert "step 7" in fired[0]
+    dumps = sorted(os.listdir(tmp_path))
+    assert dumps, "watchdog fired without dumping the incident"
+    dump = read_flight_dump(os.path.join(tmp_path, dumps[-1]))
+    assert dump["trigger"]["kind"] == "watchdog_abort"
+    assert dump["trigger"]["step"] == 7
+    assert dump["trigger"]["deadline_s"] == 0.3
+
+
+def test_watchdog_not_armed_before_first_beat_and_beats_reset():
+    """No abort before the first beat (the compile window is legal), and
+    regular beats keep the clock fresh forever."""
+    from ring_attention_tpu.elastic import Watchdog
+
+    fired = []
+    with Watchdog(0.25, abort=fired.append, poll_s=0.05) as dog:
+        time.sleep(0.6)          # unarmed: way past the deadline
+        assert not fired and not dog.fired
+        for step in range(8):    # armed, but never stale
+            dog.beat(step)
+            time.sleep(0.05)
+        assert not fired
+    with pytest.raises(ValueError, match="deadline_s"):
+        Watchdog(0.0)
+
+
+def test_watchdog_exit_code_is_distinct():
+    """114 collides with nothing the harness already distinguishes:
+    success, crash, and the chaos kill code."""
+    from ring_attention_tpu.elastic import WATCHDOG_EXIT_CODE
+
+    assert WATCHDOG_EXIT_CODE not in (0, 1, chaos.CHAOS_EXIT_CODE)
+
+
+# ----------------------------------------------------------------------
+# Cluster-wide drain + cross-process barrier: single-process halves
+# (the live two-process forms run in tests/test_multihost.py)
+# ----------------------------------------------------------------------
+
+
+def test_broadcast_drain_single_process_is_identity():
+    from ring_attention_tpu.elastic import broadcast_drain
+
+    assert broadcast_drain(False) is False
+    assert broadcast_drain(True) is True
+
+
+def test_should_stop_cluster_drains_and_thins(tmp_path):
+    """``should_stop_cluster`` sees the injector-driven preemption like
+    ``should_stop`` does, and the ``every`` thinning defers the check to
+    aligned boundaries only — the alignment that keeps every process's
+    broadcast schedule identical."""
+    from ring_attention_tpu.elastic import PREEMPT_FAULT
+
+    with PreemptionGuard() as guard:
+        assert guard.should_stop_cluster(step=0) is False
+        with resilience.inject(PREEMPT_FAULT):
+            assert guard.should_stop()  # latch the injected drain
+        # thinned: step 3 is not a multiple of every=4
+        assert guard.should_stop_cluster(every=4, step=3) is False
+        assert guard.should_stop_cluster(every=4, step=4) is True
+        assert guard.should_stop_cluster(step=5) is True
+
+
+def test_cross_process_barrier_single_process_noop():
+    from ring_attention_tpu.elastic import cross_process_barrier
+
+    t0 = time.monotonic()
+    cross_process_barrier("test:solo", timeout_s=0.1)
+    assert time.monotonic() - t0 < 0.1
+
+
+# ----------------------------------------------------------------------
+# ZeRO-1 optimizer-state sharding (the in-step knob; the base helper is
+# pinned in tests/test_utils.py)
+# ----------------------------------------------------------------------
+
+
+def test_shard_opt_state_knob_shards_moments_and_matches(rng, devices):
+    """``make_train_step(shard_opt_state=True)``: the returned Adam
+    moments carry a data-axis sharding (both tiers on a hierarchical
+    mesh), values match the unsharded step bit-for-bit on CPU, the
+    donation/offload audits cover the composed program, and the analytic
+    memory model divides the moment bytes."""
+    import optax
+
+    from ring_attention_tpu.analysis import (
+        audit_donation,
+        audit_host_offload,
+    )
+    from ring_attention_tpu.parallel import create_mesh, data_partition
+    from ring_attention_tpu.utils import train_memory_estimate
+    from ring_attention_tpu.utils.train import shard_optimizer_state
+
+    mesh = create_mesh(dcn_data_size=2, ring_size=2, data_size=2)
+    assert data_partition(mesh) == ("dcn_data", "data")
+    w = {"w": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)}
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+
+    def loss_fn(params, x):
+        return jnp.mean((x @ params["w"]) ** 2)
+
+    opt = optax.adam(1e-2)
+    with pytest.raises(ValueError, match="shard_mesh"):
+        make_train_step(loss_fn, opt, shard_opt_state=True)
+    plain = jax.jit(make_train_step(loss_fn, opt))
+    step = make_train_step(loss_fn, opt, shard_opt_state=True,
+                           shard_mesh=mesh, jit_donate=True)
+
+    state0 = shard_optimizer_state(
+        opt.init(w), mesh, axis=data_partition(mesh)
+    )
+    p1, s1, l1 = step(w, state0, x)
+    mu = s1[0].mu["w"]
+    assert "dcn_data" in str(mu.sharding.spec) and "data" in str(
+        mu.sharding.spec
+    ), mu.sharding
+    # the constraint never changes semantics (the partitioned program
+    # may re-associate reductions: tolerance, not bit-equality)
+    p0, s0, l0 = plain(w, opt.init(w), x)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(p0["w"]), np.asarray(p1["w"]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(s0[0].mu["w"]), np.asarray(mu), atol=1e-6
+    )
+    assert audit_donation(step, w, state0, x, label="zero1") == []
+    assert audit_host_offload(step, w, state0, x, label="zero1") == []
+
+    n_params = 1_000_000
+    kw = dict(n_params=n_params, batch=1, seq_len=4096, dim=256,
+              heads=8, depth=4, vocab=256)
+    base = train_memory_estimate(**kw)
+    div = train_memory_estimate(**kw, shard_opt_data=4)
+    # Adam moments (2x f32) divide 4-ways; everything else is untouched
+    moments = 2 * n_params * 4
+    assert base["params_bytes"] - div["params_bytes"] == (
+        moments - moments // 4
+    )
+    assert div["peak_hbm_bytes"] < base["peak_hbm_bytes"]

@@ -8,18 +8,42 @@ for hosts).  This is the only place ``shard_batch``'s
 ``make_array_from_process_local_data`` branch and cross-process
 collectives execute for real — the 8-virtual-device conftest mesh is
 always a single process.
+
+The elastic half (PR 15) drives the POD-SCALE acceptance matrix on the
+same harness: kill ONE worker of a live two-process cluster at every
+commit window of the multi-process checkpoint protocol (real
+``os._exit`` deaths via ``chaos_point``), restart at the surviving
+process count through ``remesh_plan``, and finish with loss parity
+against the uninterrupted baseline; plus cross-process drain (SIGTERM on
+one host drains the whole cluster), 2 -> 1 / 1 -> 2 checkpoint
+round-trip bit-exactness, and the watchdog-vs-wedged-collective pin.
 """
 
+import json
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
+
+from ring_attention_tpu.elastic import (
+    WATCHDOG_EXIT_CODE,
+    ElasticCheckpointManager,
+    chaos,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "multihost_worker.py")
+ELASTIC_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "elastic_worker.py")
+
+# cross-world loss-parity tolerance (same rule as tests/test_elastic.py:
+# params restore bit-exactly, only reduction order differs)
+TOL = 1e-4
 
 
 def _free_port() -> int:
@@ -74,3 +98,288 @@ def test_two_process_cluster_trains():
               for out in outs.values() for ln in out.splitlines()
               if "MULTIHOST-OK" in ln}
     assert len(losses) == 1, losses
+
+
+# ----------------------------------------------------------------------
+# Elastic runtime at pod scale (PR 15): kill-one-worker chaos matrix,
+# cross-process drain, round-trip bit-exactness, watchdog-vs-wedge
+# ----------------------------------------------------------------------
+
+
+def _worker_argv(ckpt_dir, loss_log, *, steps=6, sync=True,
+                 barrier=20, watchdog=None, flight=None):
+    argv = [sys.executable, ELASTIC_WORKER,
+            "--ckpt-dir", str(ckpt_dir), "--loss-log", str(loss_log),
+            "--steps", str(steps), "--barrier-timeout", str(barrier)]
+    if sync:
+        argv.append("--sync-save")
+    if watchdog is not None:
+        argv += ["--watchdog-deadline", str(watchdog)]
+    if flight is not None:
+        argv += ["--flight-dir", str(flight)]
+    return argv
+
+
+def _read_log(path) -> dict[int, float]:
+    out: dict[int, float] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    row = json.loads(line)
+                    out[row["step"]] = row["loss"]
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def _cluster(ckpt_dir, loss_log, *, chaos_faults=None, chaos_process=0,
+             steps=6, watchdog=25, timeout=360):
+    w = chaos.ChaosWorker(
+        _worker_argv(ckpt_dir, loss_log, steps=steps, watchdog=watchdog),
+        cwd=REPO_ROOT, timeout=timeout,
+    )
+    return w.run_cluster(processes=2, devices_per_process=2,
+                         chaos=chaos_faults, chaos_process=chaos_process)
+
+
+def _committed(ckpt_dir) -> list[int]:
+    return ElasticCheckpointManager(ckpt_dir).all_steps()
+
+
+@pytest.fixture(scope="module")
+def baseline4(tmp_path_factory):
+    """Uninterrupted 6-step single-process run at world 4 — the parity
+    reference every cluster/remesh trajectory must reproduce."""
+    d = tmp_path_factory.mktemp("mh_baseline")
+    log = d / "loss.jsonl"
+    w = chaos.ChaosWorker(
+        _worker_argv(d / "ck", log, sync=False), cwd=REPO_ROOT,
+        timeout=300,
+    )
+    r = w.run(devices=4)
+    assert r.returncode == 0, r.stdout + r.stderr
+    losses = _read_log(log)
+    assert sorted(losses) == list(range(6)), losses
+    return losses
+
+
+@pytest.mark.slow
+def test_cluster_kill_one_worker_matrix_then_remesh(tmp_path, baseline4):
+    """The pod-scale kill-anywhere matrix: one checkpoint directory
+    survives a violent death of ONE worker of a live two-process cluster
+    at every commit window — mid-step, mid-shard-write (victim writes
+    its own shard group), staged-but-uncommitted (process 0 dies before
+    the manifest rename), mid-resume — with the SURVIVOR bounded by the
+    barrier timeout / watchdog (never an eternal hang), and the final
+    single-process restart at the surviving device count reproduces the
+    uninterrupted baseline's loss trajectory."""
+    ck, log = tmp_path / "ck", tmp_path / "loss.jsonl"
+
+    # (1) victim worker 1 dies mid-run at step 2, after step 0 committed;
+    # worker 0's next collective loses its peer — the bounded outcomes
+    # are the watchdog abort (exit 114) or the transport erroring out,
+    # NEVER success and never a hang past the harness timeout
+    rs = _cluster(ck, log, chaos_faults={chaos.KILL_AT_STEP: 2},
+                  chaos_process=1)
+    assert rs[1].returncode == chaos.CHAOS_EXIT_CODE, rs[1].stdout
+    assert rs[0].returncode != 0, "survivor must not report success"
+    assert _committed(ck) == [0]
+
+    # (2) victim worker 1 dies MID-SHARD-WRITE of its own shard group:
+    # no manifest can exist (process 0 commits last, behind the barrier
+    # the victim never reaches) — the torn save is invisible
+    rs = _cluster(ck, log, chaos_faults=[chaos.KILL_MID_SHARD],
+                  chaos_process=1)
+    assert rs[1].returncode == chaos.CHAOS_EXIT_CODE, rs[1].stdout
+    assert rs[0].returncode != 0, "survivor must not report success"
+    assert _committed(ck) == [0], (
+        "a torn multi-process save leaked into the committed steps"
+    )
+
+    # (3) process 0 dies with the staging dir COMPLETE (its own shards +
+    # manifest candidates written) but the commit rename not executed
+    rs = _cluster(ck, log, chaos_faults=[chaos.KILL_PRE_COMMIT],
+                  chaos_process=0)
+    assert rs[0].returncode == chaos.CHAOS_EXIT_CODE, rs[0].stdout
+    assert rs[1].returncode != 0
+    assert _committed(ck) == [0]
+
+    # (4) victim worker 1 dies mid-resume: restore is read-only — the
+    # checkpoint survives a killed reader fully intact
+    rs = _cluster(ck, log, chaos_faults=[chaos.KILL_MID_RESUME],
+                  chaos_process=1)
+    assert rs[1].returncode == chaos.CHAOS_EXIT_CODE, rs[1].stdout
+    assert _committed(ck) == [0]
+
+    # (5) restart at the SURVIVING process count (one process, half the
+    # devices) — remesh_plan drops the dcn tier, the resharded load is
+    # bit-exact, and every step any run logged matches the baseline
+    w = chaos.ChaosWorker(
+        _worker_argv(ck, log, sync=False), cwd=REPO_ROOT, timeout=300,
+    )
+    r = w.run(devices=2)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "re-mesh: dcn_data 2 -> 1 (process count changed)" in r.stdout
+    assert "re-mesh resume" in r.stdout
+    losses = _read_log(log)
+    assert sorted(losses) == list(range(6))
+    for step, loss in losses.items():
+        assert abs(loss - baseline4[step]) < TOL, (
+            f"step {step}: {loss} vs baseline {baseline4[step]}"
+        )
+
+
+@pytest.mark.slow
+def test_cluster_grow_1_to_2_processes(tmp_path, baseline4):
+    """Grow the pod mid-run: 3 steps single-process, then resume on a
+    live two-process cluster — the dcn tier appears, the checkpoint
+    re-scatters, and the trajectory still matches the baseline."""
+    ck, log = tmp_path / "ck", tmp_path / "loss.jsonl"
+    w = chaos.ChaosWorker(
+        _worker_argv(ck, log, steps=3, sync=False), cwd=REPO_ROOT,
+        timeout=300,
+    )
+    r = w.run(devices=4)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rs = _cluster(ck, log, steps=6, watchdog=None)
+    for pid, r in enumerate(rs):
+        assert r.returncode == 0, f"worker {pid}:\n{r.stdout[-1500:]}"
+    assert any("dcn_data 1 -> 2" in r.stdout or "re-mesh" in r.stdout
+               for r in rs), rs[0].stdout
+    losses = _read_log(log)
+    assert sorted(losses) == list(range(6))
+    for step, loss in losses.items():
+        assert abs(loss - baseline4[step]) < TOL, (
+            f"step {step}: {loss} vs baseline {baseline4[step]}"
+        )
+
+
+@pytest.mark.slow
+def test_cluster_cross_process_drain(tmp_path):
+    """SIGTERM ONE worker of a live two-process cluster: the drain flag
+    broadcasts at the step boundary, BOTH processes finish the in-flight
+    step, cooperate in one final multi-process save, and exit 0 — the
+    surviving half of the pod never wedges on a half-drained peer."""
+    ck, log = tmp_path / "ck", tmp_path / "loss.jsonl"
+    port = _free_port()
+    env_base = dict(os.environ)
+    env_base.pop("XLA_FLAGS", None)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base["RING_ATTN_CHAOS_DEVICES"] = "2"
+    procs = []
+    for pid in range(2):
+        env = dict(env_base)
+        env[chaos.CLUSTER_ENV] = f"{pid}:2:{port}"
+        procs.append(subprocess.Popen(
+            _worker_argv(ck, log, steps=2000, sync=False,
+                         barrier=60),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO_ROOT,
+        ))
+    outs: dict[int, str] = {}
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if len(_read_log(log)) >= 2:  # compiled and stepping
+                break
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.1)
+        assert all(p.poll() is None for p in procs), [
+            p.communicate()[0] for p in procs
+        ]
+        # preempt worker 1 ONLY — worker 0 must drain via the broadcast
+        procs[1].send_signal(signal.SIGTERM)
+        for pid, p in enumerate(procs):
+            out, _ = p.communicate(timeout=180)
+            outs[pid] = out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for pid, p in enumerate(procs):
+        assert p.returncode == 0, (
+            f"worker {pid} rc={p.returncode}:\n{outs.get(pid, '')[-1500:]}"
+        )
+    assert "DRAINED SIGTERM" in outs[1], outs[1][-800:]
+    assert "DRAINED peer" in outs[0], outs[0][-800:]
+    # the drained step is committed and resumable
+    drained = int(outs[1].split("DRAINED SIGTERM step=")[1].split()[0])
+    assert drained in _committed(ck), (drained, _committed(ck))
+
+
+@pytest.mark.slow
+def test_checkpoint_roundtrip_2_to_1_and_1_to_2_bitexact():
+    """Both directions of the cross-process-count round-trip, via the
+    machine-checked verify rows: a two-process save restores bit-exactly
+    at one process, and a one-process save restores bit-exactly on a
+    live two-process cluster."""
+    from ring_attention_tpu.elastic.verify import (
+        check_mp_barrier,
+        check_mp_commit_roundtrip,
+        check_mp_restore_grow,
+    )
+
+    for name, check in (
+        ("mp_barrier", check_mp_barrier),
+        ("mp_commit_roundtrip", check_mp_commit_roundtrip),
+        ("mp_restore_grow", check_mp_restore_grow),
+    ):
+        violations = check()
+        assert not violations, f"{name}: {violations}"
+
+
+@pytest.mark.slow
+def test_elastic_cli_multiprocess_rows():
+    """`check_contracts.py --elastic` runs the full 7/7 including the
+    spawned two-process rows."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "check_contracts.py"),
+         "--elastic"],
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "7/7 elastic checks hold" in proc.stdout
+
+
+@pytest.mark.slow
+def test_cluster_watchdog_converts_wedged_collective(tmp_path):
+    """The wedge pin at pod scale: an armed ``delay_tap`` holds the
+    victim's compiled step for longer than the watchdog deadline; the
+    peer wedges inside its real cross-process collective waiting for
+    the victim's contribution.  BOTH must die the watchdog's bounded
+    death (exit 114) — never an eternal hang — and the incident dumps
+    record the stalled step.
+
+    The victim is process 0: the in-graph callback of a replicated
+    value executes on the process holding its first shard (see
+    ``delay_tap``), so a wedge armed on any other process would no-op
+    in-graph — and the pin here is the cluster-wide conversion, which
+    is symmetric (the peer's wedge is a genuine stuck collective)."""
+    from ring_attention_tpu.utils import read_flight_dump
+
+    ck, log = tmp_path / "ck", tmp_path / "loss.jsonl"
+    flight = tmp_path / "flight"
+    w = chaos.ChaosWorker(
+        _worker_argv(ck, log, steps=8, watchdog=6, flight=flight),
+        cwd=REPO_ROOT, timeout=360,
+    )
+    rs = w.run_cluster(
+        processes=2, devices_per_process=2,
+        chaos={"wedge_at_step": 2, "wedge_seconds": 120},
+        chaos_process=0,
+    )
+    for pid, r in enumerate(rs):
+        assert r.returncode == WATCHDOG_EXIT_CODE, (
+            f"worker {pid} rc={r.returncode}:\n{r.stdout[-1500:]}"
+        )
+        assert "watchdog: no heartbeat" in r.stdout, r.stdout[-800:]
+    dumps = sorted(
+        os.path.join(flight, n) for n in os.listdir(flight)
+    ) if os.path.isdir(flight) else []
+    assert dumps, "watchdog fired without an incident dump"
+    kinds = {read_flight_dump(d)["trigger"]["kind"] for d in dumps}
+    assert "watchdog_abort" in kinds, kinds

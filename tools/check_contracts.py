@@ -121,8 +121,14 @@ def main(argv: list[str] | None = None) -> int:
                              "(manifest schema round-trip, resharded-"
                              "load == direct-load at a changed mesh, "
                              "corrupt-shard fallback, commit-debris "
-                             "sweep) instead of the collective "
+                             "sweep, and the spawned two-process rows: "
+                             "barrier semantics + 2->1/1->2 commit "
+                             "round-trips) instead of the collective "
                              "contracts")
+    parser.add_argument("--no-multiprocess", action="store_true",
+                        help="with --elastic: skip the spawned two-"
+                             "process cluster rows (4 checks instead of "
+                             "7) — the quick in-process subset")
     args = parser.parse_args(argv)
 
     # must precede the first jax import
@@ -200,7 +206,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.elastic:
         from ring_attention_tpu.elastic.verify import run_elastic_suite
 
-        checks = run_elastic_suite()
+        checks = run_elastic_suite(multiprocess=not args.no_multiprocess)
         failed_names = [name for name, v in checks if v]
         if args.json:
             print(json.dumps({
