@@ -429,6 +429,9 @@ def _worker(impl: str, seq_len: int, mode: str, extra: dict) -> None:
     if mode == "q8":
         _q8_worker(seq_len, int(extra.get("ring", 4)))
         return
+    if mode == "fused":
+        _fused_worker(seq_len, int(extra.get("ring", 4)))
+        return
     if mode == "decode":
         _decode_worker(impl, seq_len, extra)
         return
@@ -681,6 +684,96 @@ def _hops_worker(seq_len: int, ring: int) -> None:
                 "seq_len": seq_len,
                 "ring": ring,
                 "impl": "pallas-hops",
+                "device": getattr(dev, "device_kind", str(dev)),
+                "ms_per_step": round(secs * 1e3, 2),
+                "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
+def _fused_worker(seq_len: int, ring: int) -> None:
+    """Single-chip timing of the fused-ring kernel's whole hop chain.
+
+    Where ``_hops_worker`` times the scan path's per-hop SEQUENCE of span
+    launches (one ``pallas_call`` per hop, carry re-materialized through
+    HBM at every boundary), this worker times the SAME work as ONE
+    launch: ``ops/pallas_ring.py::fused_ring_local`` sweeps every hop's
+    KV span inside a single kernel, the f32 ``(acc, m, l)`` state
+    resident in VMEM scratch across hops.  The hop schedule is the real
+    one — ``parallel/ring.py::_fused_tables`` for the causal last rank,
+    the exact tables the multi-chip fused ring prefetches — so
+    ``fused262k / ring_hops_tflops`` is the measured launch-boundary
+    cost the fused path deletes.  The analytic comms terms ride from
+    ``telemetry.ring_comms_accounting(impl="fused")``: ``kernel_launches
+    == 1``, ``dispatch_overhead_s == 0``, ``fwd_collectives == 0`` (hops
+    are in-kernel remote DMAs, pinned by phase 0's ``fused_ring``
+    fingerprint row), overlap ~1.0 at the north-star shape.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ring_attention_tpu.ops import pallas_ring
+    from ring_attention_tpu.parallel import ring as ring_mod
+    from ring_attention_tpu.utils.telemetry import ring_comms_accounting
+
+    dev, peak = _device_peak()
+    n_local = seq_len // ring
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (1, HEADS, n_local, DIM_HEAD), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, HEADS, seq_len, DIM_HEAD), jnp.bfloat16)
+    scale = DIM_HEAD**-0.5
+
+    # causal last rank: hop 0 = banded diagonal, hops 1..R-1 full spans —
+    # the same (ring - 0.5) work as _hops_worker's span sequence
+    origins, his, los, works = ring_mod._fused_tables(
+        ring - 1, ring, n_local, True, False, None, ring
+    )
+
+    def hop_sequence(q):
+        out, _ = pallas_ring.fused_ring_local(
+            q, k, v, origins=origins, his=his, los=los, works=works,
+            n_local=n_local, scale=scale, block_q=1024, block_k=1024,
+        )
+        return out
+
+    iters = 3
+
+    @jax.jit
+    def chained(q):
+        def body(carry, _):
+            o = hop_sequence(carry)
+            return carry + 1e-3 * o.astype(carry.dtype), o[0, 0, 0, 0]
+
+        out, ys = jax.lax.scan(body, q, None, length=iters)
+        return ys.astype(jnp.float32).sum()
+
+    compile_s, secs = _timed(chained, (q,), iters)
+    flops = (
+        FWD_MATMULS * 2 * HEADS * DIM_HEAD * n_local * n_local * (ring - 0.5)
+    )
+    tflops = flops / secs / 1e12
+    comms = ring_comms_accounting(
+        ring_size=ring, seq_len=seq_len, kv_heads=HEADS, heads=HEADS,
+        dim_head=DIM_HEAD, dtype_bytes=2, impl="fused", peak_tflops=peak,
+    )
+    print(
+        json.dumps(
+            {
+                "value": round(tflops, 4),
+                "vs_baseline": round(tflops / peak, 4),
+                "mfu": round(tflops / peak, 4),
+                "seq_len": seq_len,
+                "ring": ring,
+                "kernel_launches": comms["kernel_launches"],
+                "dispatch_overhead_s": comms["dispatch_overhead_s"],
+                "hop_bytes": comms["hop_bytes"],
+                "fwd_collectives": comms["fwd_collectives"],
+                "bwd_collectives": comms["bwd_collectives"],
+                "hop_overlap_fraction": comms["hop_overlap_fraction"],
+                "tokens_per_sec": round(seq_len / secs),
+                "impl": "pallas-fused",
                 "device": getattr(dev, "device_kind", str(dev)),
                 "ms_per_step": round(secs * 1e3, 2),
                 "compile_s": round(compile_s, 1),
@@ -1826,6 +1919,37 @@ def main() -> None:
                     payload["value"] / result["ring_hops_tflops"], 4
                 )
             log.append(f"q8:pallas@{TARGET_SEQ}[int8-compute]: ok")
+        else:
+            log.append(err)
+
+    # phase 4f — fused262k (PR 18): the same hop chain as phase 4, ONE
+    # kernel launch — ops/pallas_ring.py sweeps every hop's span with the
+    # f32 carry resident in VMEM, so fused_vs_ring_hops is the measured
+    # launch-boundary cost the fused path deletes.  The analytic row
+    # (kernel_launches=1, dispatch overhead 0, fwd_collectives=0, overlap
+    # ~1.0) rides along; phase 0's fused_ring fingerprint pins the
+    # in-kernel remote-DMA counts (zero ppermutes) from lowered Mosaic
+    # even on wedged-TPU rounds.
+    if got_target and budget_left(900):
+        payload, err = _run_attempt(
+            "pallas", TARGET_SEQ, "fused",
+            min(900, deadline - time.monotonic()),
+            {"ring": 4},
+        )
+        if payload is not None:
+            result["fused262k"] = payload["value"]
+            result["fused_kernel_launches"] = payload["kernel_launches"]
+            result["fused_fwd_collectives"] = payload["fwd_collectives"]
+            result["fused_overlap_fraction"] = payload["hop_overlap_fraction"]
+            result["fused_tokens_per_sec"] = payload["tokens_per_sec"]
+            result["fused_ms"] = payload["ms_per_step"]
+            if result.get("ring_hops_tflops"):
+                # launch-free-hops dividend: one launch vs ring launches
+                # on the identical span schedule and device
+                result["fused_vs_ring_hops"] = round(
+                    payload["value"] / result["ring_hops_tflops"], 4
+                )
+            log.append(f"fused:pallas@{TARGET_SEQ}[1-launch]: ok")
         else:
             log.append(err)
 

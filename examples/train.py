@@ -98,6 +98,13 @@ def main() -> None:
                          "bounded restart instead of an eternal hang")
     ap.add_argument("--use-pallas", action="store_true",
                     help="Mosaic kernels (TPU; interpreter elsewhere)")
+    ap.add_argument("--impl", choices=["auto", "fused", "pallas", "xla"],
+                    default=None,
+                    help="kernel path with graceful degradation (overrides "
+                         "--use-pallas): fused = single-launch fused-ring "
+                         "kernel with in-kernel remote KV DMA "
+                         "(ops/pallas_ring.py); auto prefers fused, then "
+                         "pallas, then xla, recording each fallback")
     ap.add_argument("--bidirectional", action="store_true",
                     help="circulate KV halves both ring directions (duplex ICI)")
     ap.add_argument("--counter-rotate", action="store_true",
@@ -294,6 +301,7 @@ def main() -> None:
         use_ring=mesh is not None,
         sequence_parallel="hybrid" if hybrid else "ring",
         use_pallas=args.use_pallas,
+        impl=args.impl,
         ring_bidirectional=args.bidirectional,
         ring_counter_rotate=args.counter_rotate,
         ring_hop_compression=args.hop_compression,

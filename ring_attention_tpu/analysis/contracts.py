@@ -954,6 +954,126 @@ def check_scan_contract(strategy: str, mesh=None, *, directions=None,
     return reports
 
 
+# The fused-ring row (ops/pallas_ring.py::fused_ring_remote) pins a
+# DIFFERENT surface from the scan-path contracts above: hops are in-kernel
+# remote DMAs, so the proof counts Mosaic DMA/semaphore primitives from the
+# traced kernel body instead of HLO collectives.  The counts are structural
+# (static ``pl.when`` branches over the double-buffer parity), so they are
+# ring-size independent: one copy-start + matching wait per buffer slot,
+# the neighbor barrier handshake, and — the launch-free-hops claim itself —
+# ZERO ppermutes anywhere in the forward.
+FUSED_RING_PRIMS = (
+    "dma_start", "dma_wait", "semaphore_signal", "semaphore_wait",
+    "get_barrier_semaphore", "ppermute",
+)
+FUSED_RING_EXPECTED = {
+    "dma_start": 2,
+    "dma_wait": 4,
+    "semaphore_signal": 2,
+    "semaphore_wait": 1,
+    "get_barrier_semaphore": 1,
+    "ppermute": 0,
+}
+
+
+def jaxpr_primitive_counts(closed_jaxpr, names) -> dict[str, int]:
+    """Exhaustive primitive counts from a traced program, descending into
+    every sub-jaxpr a param carries (scan/cond/while bodies, shard_map,
+    pallas_call kernels) — unlike :func:`jaxpr_collectives` there is no
+    scan multiplication; this counts traced instructions."""
+    counts: Counter = Counter()
+
+    def walk(jaxpr) -> None:
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in names:
+                counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    walk(sub)
+
+    walk(closed_jaxpr.jaxpr)
+    return dict(counts)
+
+
+def check_fused_ring_contract(
+    *, quantized: bool = False, b: int = 1, heads: int = 4,
+    kv_heads: int = 2, seq: int = 256, dim_head: int = 16,
+) -> ContractReport:
+    """The fused-ring contract row: trace the single-launch remote kernel
+    under ``shard_map`` on the full-device CPU ring and hold its traced
+    body to :data:`FUSED_RING_EXPECTED` — the expected in-kernel remote
+    copies and semaphore handshakes, and zero ``ppermute``s (the scan-path
+    ring's per-hop collective has no business in the fused forward).  The
+    ``quantized`` variant feeds PR 13's packed int8 payload through the
+    same kernel and must produce IDENTICAL counts: scales ride the KV
+    buffer, never their own copy."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import pallas_ring
+    from ..ops import quant as _quant
+    from ..parallel.mesh import SEQ_AXIS, data_partition, seq_partition
+    from ..utils import compat
+    from jax.sharding import PartitionSpec as P
+
+    mesh = default_mesh("ring")
+    dims = _mesh_dims(mesh)
+    ring = dims["ring"]
+    n_local = seq // ring
+    dims.update(b=b, heads=heads, kv_heads=kv_heads, seq=seq,
+                dim_head=dim_head, chunk=n_local)
+    rng = np.random.default_rng(0)
+    b_full = b * dims["data"] * dims["dcn"]
+
+    def mk(h):
+        return jnp.asarray(rng.standard_normal((b_full, h, seq, dim_head)),
+                           jnp.float32)
+
+    def core(q, k, v):
+        rank = lax.axis_index(SEQ_AXIS)
+        his = jnp.full((ring,), n_local, jnp.int32)
+        los = jnp.full((ring,), -n_local, jnp.int32)
+        works = jnp.ones((ring,), jnp.int32)
+        nbrs = jnp.stack(
+            [(rank - 1) % ring, (rank + 1) % ring]
+        ).astype(jnp.int32)
+        payload = (_quant.pack_kv(k, v, v_block=n_local)
+                   if quantized else None)
+        out, _ = pallas_ring.fused_ring_remote(
+            q, k, v, his=his, los=los, works=works, nbrs=nbrs,
+            scale=dim_head ** -0.5, payload=payload,
+        )
+        return out
+
+    spec = P(data_partition(mesh), None, seq_partition(mesh), None)
+    fn = compat.shard_map(
+        core, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(fn)(mk(heads), mk(kv_heads), mk(kv_heads))
+    counted = jaxpr_primitive_counts(jaxpr, FUSED_RING_PRIMS)
+
+    report = ContractReport(
+        strategy="fused_ring_q8" if quantized else "fused_ring",
+        direction="fwd", impl="fused",
+        mesh_shape=tuple(mesh.shape.values()), dims=dims,
+        # zeros stay explicit: "ppermute": 0 IS the launch-free-hops pin
+        counts={p: counted.get(p, 0) for p in FUSED_RING_PRIMS},
+        expected=dict(FUSED_RING_EXPECTED),
+    )
+    for prim, want in report.expected.items():
+        got = report.counts.get(prim, 0)
+        if got != want:
+            rule = ("launch-free-hops" if prim == "ppermute"
+                    else "fused-ring-dma")
+            report.violations.append(
+                f"{report.strategy}/fwd (traced kernel): {prim} x{got}, "
+                f"contract says {want} at {dims_str(dims)} [rule: {rule}]"
+            )
+    return report
+
+
 def check_hybrid_hop_reduction(world: int | None = None, ulysses: int = 2,
                                **shape_kw) -> ContractReport:
     """The tentpole relation, proven from two compiled programs: at equal
@@ -1254,6 +1374,8 @@ def run_contract_suite(strategies=None, *, scan: bool = True,
 
         if len(jax.devices()) >= 4:
             reports.extend(check_dcn_isolation(**shape_kw))
+        reports.append(check_fused_ring_contract())
+        reports.append(check_fused_ring_contract(quantized=True))
     return reports
 
 
@@ -1280,6 +1402,12 @@ def collective_fingerprint(
              .replace("all-reduce", "all_reduce"): v
             for k, v in sorted(rep.counts.items())
         }
+        ok = ok and rep.ok
+    # the fused-ring rows speak Mosaic primitives, not HLO collectives:
+    # in-kernel remote-copy/semaphore counts with the zero-ppermute pin
+    for quantized in (False, True):
+        rep = check_fused_ring_contract(quantized=quantized)
+        out[rep.strategy] = dict(sorted(rep.counts.items()))
         ok = ok and rep.ok
     out["contract_ok"] = ok
     return out

@@ -860,17 +860,141 @@ def prove_mask_case(case: MaskCoverageCase) -> CoverageReport:
 
 
 # ---------------------------------------------------------------------------
+# Fused ring: the single-launch grid (ops/pallas_ring.py) held to the
+# same global-position oracle
+# ---------------------------------------------------------------------------
+
+FUSED_CASES: tuple[CoverageCase, ...] = (
+    CoverageCase("fused/contiguous", ring=4, n_local=16, block=4),
+    CoverageCase("fused/contiguous/window", ring=4, n_local=16, block=4,
+                 window=24),
+    CoverageCase("fused/limited-passes", ring=4, n_local=16, block=4,
+                 window=8, passes=2),
+    CoverageCase("fused/striped", ring=4, n_local=16, block=4,
+                 layout="striped"),
+    CoverageCase("fused/striped/window", ring=4, n_local=16, block=4,
+                 layout="striped", window=20),
+)
+
+
+def prove_fused_case(case: CoverageCase) -> CoverageReport:
+    """Prove one fused-ring row: the prefetched hop tables
+    (``parallel/ring.py::_fused_tables``) and the kernel's per-tile live
+    predicate (``ops/pallas_ring.py::_fused_local_kernel``) against the
+    global-position oracle.
+
+    The fused kernel has no interior/edge split — every live tile applies
+    the band mask in-kernel — so the obligations are: (a) the per-hop
+    band exactly realizes the oracle for that (rank, origin) pairing
+    (``work=0`` hops must be all-dead); (b) a tile the live predicate
+    skips holds no live element and a tile it visits holds at least one;
+    (c) summing live elements across the in-launch hop schedule
+    reproduces the intended global mask exactly once per element."""
+    from ..parallel import ring as ring_mod
+
+    report = CoverageReport(name=case.name)
+    W, n, blk = case.ring, case.n_local, case.block
+    passes = case.passes or W
+    striped = case.layout == "striped"
+    for rank in range(W):
+        origins, his, los, works = (
+            np.asarray(t) for t in ring_mod._fused_tables(
+                rank, passes, n, True, striped, case.window, W
+            )
+        )
+        qpos = _positions(case.layout, rank, n, W)
+        counts = np.zeros((n, n * W), np.int64)
+        visited = np.zeros(n * W, bool)
+        for hop in range(passes):
+            report.hops += 1
+            label = f"{case.name}/rank{rank}/hop{hop}"
+            origin, work = int(origins[hop]), bool(works[hop])
+            hi, lo = int(his[hop]), int(los[hop])
+            if origin != (rank - hop) % W:
+                report.violations.append(
+                    f"{label}: table origin {origin}, the in-launch KV "
+                    f"stream delivers {(rank - hop) % W} at this hop "
+                    f"[rule: tile-coverage-sound]"
+                )
+                continue
+            kpos = _positions(case.layout, origin, n, W)
+            truth = oracle_mask(qpos, kpos, case.window)
+            # (a) the runtime band IS the oracle for this pairing —
+            # the sentinel offsets (hi=n, lo=-n) are vacuous over the
+            # in-block diff range, so band_mask takes them unconditionally
+            rt = (band_mask(n, n, hi, lo) if work
+                  else np.zeros((n, n), bool))
+            if not np.array_equal(rt, truth):
+                i, j = np.argwhere(rt ^ truth)[0]
+                kind = ("drops live" if truth[i, j] else "admits dead")
+                report.violations.append(
+                    f"{label}: band (hi={hi}, lo={lo}, work={int(work)}) "
+                    f"{kind} element at local ({int(i)}, {int(j)}) "
+                    f"[rule: tile-coverage-sound]"
+                )
+                continue
+            # (b) the kernel's per-tile live predicate, verbatim
+            for qi in range(n // blk):
+                for kb in range(n // blk):
+                    row0, col0 = qi * blk, kb * blk
+                    live = (work
+                            and col0 <= row0 + blk - 1 + hi
+                            and col0 + blk - 1 >= row0 + lo)
+                    report.tiles += 1
+                    t = truth[row0:row0 + blk, col0:col0 + blk]
+                    if live:
+                        report.work += 1
+                        if not t.any():
+                            report.violations.append(
+                                f"{label}: live predicate visits dead "
+                                f"tile (q {qi}, k {kb}) "
+                                f"[rule: tile-coverage-tight]"
+                            )
+                    elif t.any():
+                        report.violations.append(
+                            f"{label}: live predicate skips tile "
+                            f"(q {qi}, k {kb}) holding live work "
+                            f"[rule: tile-coverage-sound]"
+                        )
+            if work:
+                visited[kpos] = True
+                counts[:, kpos] += truth
+        # (c) exactly-once across the in-launch schedule
+        intended = oracle_mask(qpos, np.arange(n * W), case.window)
+        intended = intended & visited[None, :]
+        if not np.array_equal(counts, intended.astype(np.int64)):
+            diff = counts - intended.astype(np.int64)
+            i, j = np.argwhere(diff)[0]
+            kind = ("dropped from" if diff[i, j] < 0
+                    else "double-counted into")
+            report.violations.append(
+                f"{case.name}: fused schedule {kind} the softmax: rank "
+                f"{rank} element (local q {int(i)}, global k {int(j)}) "
+                f"computed {int(counts[i, j])}x, intended "
+                f"{int(intended[i, j])}x [rule: tile-coverage-sound]"
+            )
+    return report
+
+
+def prove_fused() -> list[CoverageReport]:
+    """All fused-ring rows (the coverage half of the fused acceptance)."""
+    return [prove_fused_case(case) for case in FUSED_CASES]
+
+
+# ---------------------------------------------------------------------------
 # Suite + fingerprint
 # ---------------------------------------------------------------------------
 
 
 def run_coverage_suite() -> list[CoverageReport]:
     """Every matrix row — the fixed strategy x layout x masking rows,
-    the zig-zag rectangular-grid row, and the mask-algebra rows.
-    All-ok == every grid the compiler emits is proven sound and tight."""
+    the zig-zag rectangular-grid row, the mask-algebra rows, and the
+    fused-ring single-launch grid.  All-ok == every grid the compiler
+    emits is proven sound and tight."""
     reports = [prove_case(case) for case in CASES]
     reports.append(prove_zigzag())
     reports.extend(prove_mask_case(case) for case in MASK_CASES)
+    reports.extend(prove_fused())
     return reports
 
 

@@ -82,6 +82,19 @@ past PR, with the shim/convention that prevents it:
          Quantize through the seam; a genuinely unrelated 127 carries a
          reasoned allow.
 
+  RA013  remote-DMA / semaphore primitives (``make_async_remote_copy`` /
+         ``make_async_copy`` / ``semaphore_signal`` / ``semaphore_wait`` /
+         ``get_barrier_semaphore`` / ``SemaphoreType``) outside the fused
+         ring kernel module (``ops/pallas_ring.py``).  The fused ring's
+         correctness rests on ONE signal/wait protocol — the send-grant
+         barrier and per-slot DMA semaphores that
+         ``analysis/contracts.py::check_fused_ring_contract`` pins by
+         exact count from the lowered module.  A second module issuing
+         raw semaphore ops can deadlock the ring (an unmatched signal
+         leaves a neighbor waiting forever) and silently invalidates the
+         counted contract; new in-kernel communication goes through the
+         fused module's seam, anything else carries a reasoned allow.
+
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
 itself a violation.  See docs/static_analysis.md.
@@ -156,6 +169,18 @@ SIGNAL_MODULES = (
     "utils/resilience.py",
 )
 
+# RA013: the remote-DMA / semaphore primitive surface, and the one module
+# (the fused ring kernel) allowed to issue it — its signal/wait protocol
+# is pinned by exact count in analysis/contracts.py.
+REMOTE_DMA_CALLS = {
+    "make_async_remote_copy",
+    "make_async_copy",
+    "semaphore_signal",
+    "semaphore_wait",
+    "get_barrier_semaphore",
+}
+FUSED_KERNEL_MODULE = "ops/pallas_ring.py"
+
 # RA012: the one module allowed to spell the int8 full-scale constant in
 # arithmetic (every quant/dequant codec lives there).
 QUANT_SEAM_MODULE = "ops/quant.py"
@@ -213,6 +238,9 @@ class _Linter(ast.NodeVisitor):
             m in rel.replace("\\", "/") for m in SIGNAL_MODULES
         )
         self.in_quant_seam = rel.replace("\\", "/").endswith(QUANT_SEAM_MODULE)
+        self.in_fused_seam = rel.replace("\\", "/").endswith(
+            FUSED_KERNEL_MODULE
+        )
         self.traced_pkg = any(
             rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
             or f"/{p}/" in rel.replace("\\", "/")
@@ -269,6 +297,13 @@ class _Linter(ast.NodeVisitor):
                 self.flag(node, "RA002",
                           "jax.jit bypasses utils/compat.jit "
                           "(donation degradation, package jit policy)")
+        if (not self.in_fused_seam
+                and "SemaphoreType" in _attr_chain(node).split(".")):
+            self.flag(node, "RA013",
+                      "SemaphoreType outside ops/pallas_ring.py — semaphore "
+                      "scratch allocation belongs to the fused ring's "
+                      "counted signal/wait protocol (contracts.py pins it)")
+            return  # don't re-flag the chain's own sub-attributes
         self.generic_visit(node)
 
     # -- RA003..RA007: calls ------------------------------------------
@@ -299,6 +334,13 @@ class _Linter(ast.NodeVisitor):
                           "preemption semantics (drain, save, incident "
                           "dump) live in elastic.PreemptionGuard/chaos; "
                           "an ad-hoc handler or kill bypasses the drain")
+
+        if name in REMOTE_DMA_CALLS and not self.in_fused_seam:
+            self.flag(node, "RA013",
+                      f"remote-DMA/semaphore primitive {name}() outside "
+                      "ops/pallas_ring.py — the fused ring owns the one "
+                      "counted signal/wait protocol (contracts.py pins "
+                      "it); a stray semaphore op can deadlock the ring")
 
         if name in COLLECTIVE_CALLS and self.scope_depth == 0:
             self.flag(node, "RA004",
@@ -452,7 +494,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA011)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA013)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

@@ -65,6 +65,7 @@ HARDWARE_SERIES = {
     "hybrid262k_tflops": ("hybrid262k", +1),
     "counter262k_tflops": ("counter262k", +1),
     "fwd262k_q8_tflops": ("fwd262k_q8", +1),
+    "fused262k_tflops": ("fused262k", +1),
     "packed262k_tokens_per_sec": ("packed262k", +1),
     "decode_ms_per_token": ("decode_ms_per_token", -1),
 }
@@ -100,6 +101,15 @@ COMMS_REFERENCE: dict[str, dict[str, Any]] = {
         dtype_bytes=2, counter_rotate=True, hop_compression="int8",
         compute_dtype="int8",
     ),
+    # PR 18: the fused single-launch ring at the north-star shape — the
+    # analytic hop/byte accounting matches ring8_262k exactly (the data
+    # that must move is impl-independent); what the row pins is the
+    # launch model: kernel_launches=1 and fwd_collectives=0 (hops are
+    # in-kernel remote DMAs, not ppermutes)
+    "fused8_262k": dict(
+        ring_size=8, seq_len=262144, kv_heads=8, dim_head=64,
+        dtype_bytes=2, impl="fused",
+    ),
 }
 
 # ring_comms_accounting keys kept per reference config (all exact ints).
@@ -111,6 +121,9 @@ COMMS_KEYS = (
     # f32 (acc, m, l) state (invariant under every compute_dtype — the
     # precision auditor's contract as a pinned number)
     "matmul_operand_bytes", "accumulator_bytes",
+    # PR 18: the launch model — passes launches for the scan path, 1 for
+    # the fused ring (the launch-free-hops claim as a pinned int)
+    "kernel_launches",
 )
 
 

@@ -103,11 +103,14 @@ class RingAttention(nn.Module):
     mesh: Mesh | None = None
     use_pallas: bool = False
     # kernel-path selection with graceful degradation (overrides use_pallas
-    # when set): "pallas" | "xla" | "auto".  "auto" resolves through
-    # utils/resilience.py at trace time — the Pallas kernels when a
-    # one-shot compile probe passes, the XLA flash path otherwise, with a
-    # one-shot warning and a queryable degradation record.  use_pallas
-    # remains as the explicit legacy switch.
+    # when set): "fused" | "pallas" | "xla" | "auto".  "auto" resolves
+    # through utils/resilience.py at trace time — the fused ring
+    # (ops/pallas_ring.py: one launch, in-kernel remote KV DMA) when its
+    # probe passes, else the scan-path Pallas kernels, else the XLA flash
+    # path, with a one-shot warning and a queryable degradation record.
+    # "fused" applies to the "ring" strategy and the hybrid outer ring;
+    # other strategies run it as "pallas".  use_pallas remains as the
+    # explicit legacy switch.
     impl: str | None = None
     # split the (non-ring) pallas launch into this many per-head-group
     # kernel programs — bit-identical results; the escape hatch for
@@ -231,13 +234,24 @@ class RingAttention(nn.Module):
             ),
         )
 
-    def _use_pallas(self) -> bool:
-        """Resolve the kernel path for this call (trace time, cached probe)."""
+    def _kernel_impl(self) -> str:
+        """Resolve the kernel path for this call (trace time, cached probe):
+        "fused" | "pallas" | "xla".  Counter-rotation has no fused form
+        (the alternating Q/KV schedule cannot ride one launch), so a
+        resolved "fused" degrades to the scan-path Pallas ring there."""
         if self.impl is None:
-            return self.use_pallas
-        from ..utils import resilience
+            resolved = "pallas" if self.use_pallas else "xla"
+        else:
+            from ..utils import resilience
 
-        return resilience.resolve_attention_impl(self.impl) == "pallas"
+            resolved = resilience.resolve_ring_impl(self.impl)
+        if resolved == "fused" and self.ring_counter_rotate:
+            return "pallas"
+        return resolved
+
+    def _use_pallas(self) -> bool:
+        """True when this call runs on Pallas kernels (scan-path or fused)."""
+        return self._kernel_impl() in ("pallas", "fused")
 
     def _compute_dtype(self) -> str | None:
         """Validated int8-compute knob for this call.
@@ -606,7 +620,7 @@ class RingAttention(nn.Module):
                 causal=self._eff_causal(), striped=self.striped,
                 bucket_size=bucket, max_ring_passes=max_ring_passes,
                 window=window, softclamp_value=self.softclamp_value,
-                impl="pallas" if self._use_pallas() else "xla",
+                impl=self._kernel_impl(),
                 bidirectional=bidirectional,
                 dkv_dtype=self.ring_dkv_dtype,
                 segment_ids=seg,
@@ -647,7 +661,7 @@ class RingAttention(nn.Module):
                 self._eff_causal(), self.striped,
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
-                "pallas" if self._use_pallas() else "xla",
+                self._kernel_impl(),
                 bidirectional, self.ring_dkv_dtype,
                 segment_ids=seg,
                 counter_rotate=self.ring_counter_rotate,
@@ -895,7 +909,7 @@ class RingAttention(nn.Module):
                 True, False,  # causal, contiguous (non-striped) layout
                 bucket, max_ring_passes, window,
                 self.softclamp_value, None,
-                "pallas" if self._use_pallas() else "xla",
+                self._kernel_impl(),
                 bidirectional, self.ring_dkv_dtype,
                 counter_rotate=self.ring_counter_rotate,
                 hop_compression=self.ring_hop_compression,

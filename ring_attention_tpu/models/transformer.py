@@ -99,8 +99,8 @@ class RingTransformer(nn.Module):
     mesh: Mesh | None = None
     use_pallas: bool = False
     # kernel-path selection with graceful degradation, forwarded to every
-    # RingAttention layer (see models/attention.py ``impl``): "pallas" |
-    # "xla" | "auto"; None keeps the explicit use_pallas switch
+    # RingAttention layer (see models/attention.py ``impl``): "fused" |
+    # "pallas" | "xla" | "auto"; None keeps the explicit use_pallas switch
     impl: str | None = None
     # see RingAttention.pallas_head_chunks (program-size escape hatch)
     pallas_head_chunks: int | None = None

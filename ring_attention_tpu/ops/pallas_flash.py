@@ -27,6 +27,15 @@ matmul on the MXU with static layouts.
 GQA: query heads are served by ``kv_head = q_head // g`` through BlockSpec
 index maps (no materialized repeat); dk/dv are emitted per query head and
 group-summed outside (ref ``ring_flash_attention.py:370-371``).
+
+The in-kernel carry above still costs one launch PER HOP;
+``ops/pallas_ring.py`` builds on this module's seams (``_block_sizes``
+tile fitting, ``_online_update`` softmax algebra, the banded-offset mask
+contract) to run the WHOLE ring schedule as ONE launch — the next hop's
+KV double-buffered via in-kernel async remote DMA and ``(acc, m, l)``
+resident in VMEM scratch across hops.  ``impl="fused"`` on
+``ring_flash_attention`` selects it; the backward retains this module's
+two-pass kernels.
 """
 
 from __future__ import annotations

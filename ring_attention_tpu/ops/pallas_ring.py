@@ -1,0 +1,615 @@
+"""Fused-ring Pallas forward: every ring hop inside ONE kernel launch.
+
+The scan-path ring (``parallel/ring.py``) interleaves per-hop flash kernel
+launches with ``lax.ppermute`` KV rotations, leaving XLA to decide how much
+of each transfer hides behind compute — PR 8's ``measured_overlap`` exists
+precisely because that slack is real.  This module removes the launch
+boundary itself, in two tiers:
+
+``fused_ring_local``
+    One ``pallas_call`` whose innermost grid dimension walks the certified
+    hop schedule (origin / hi / lo / work tables from
+    ``parallel/ring.py::_fused_tables``) over an all-gathered KV span,
+    carrying the f32 ``(acc, m, l)`` online-softmax state in VMEM scratch
+    across every hop — zero per-hop dispatch, zero HBM round-trips of the
+    accumulator, zero ``ppermute`` in the forward.  Runs compiled on TPU
+    and in interpret mode on CPU (the parity-test tier), and accepts the
+    int8 kernel feed from PR 13 (``quant.payload_kernel_feed`` /
+    ``quant.quantize_kv_blocks``) so quantized QK^T/PV ride the same
+    launch.
+
+``fused_ring_remote``
+    The ICI tier: the kernel itself double-buffers the NEXT rank's KV
+    block via async remote DMA (``pltpu.make_async_remote_copy`` into the
+    alternate slot of a VMEM scratch ring buffer, barrier + DMA semaphores
+    riding the same buffer) while the current hop's tiles compute.
+    Neighbor coordinates come from ``parallel/mesh.py::torus_ring_order``
+    feeding mesh construction, so logical neighbor ids ARE physical ICI
+    neighbors.  With an int8 ``pack_kv`` payload the per-row dequant
+    scales travel inside the circulated buffer (bitcast into the trailing
+    ``SCALE_BYTES`` lanes), so quantized hops need no side-channel
+    collective.  Executes on TPU only; on CPU it still *traces* — which is
+    how ``analysis/contracts.py`` counts the in-kernel ``dma_start`` /
+    semaphore primitives and proves the forward carries zero ppermutes.
+
+Both tiers share ``ops/pallas_flash.py``'s tile math (``_online_update``)
+and banded-offset mask contract (attend iff ``lo <= j - i <= hi`` in
+per-hop local coordinates), so fused output is tile-order-identical to the
+scan path and parity pins can be tight.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import EPSILON, MASK_VALUE
+from .pallas_flash import (
+    _block_sizes,
+    _interpret_default,
+    _online_update,
+    _sds,
+    _unify_vma,
+)
+from . import quant as _quant
+from .quant import QuantizedBlockKV
+from ..utils import compat
+from ..utils.validate import check_attention_args
+
+# One collective_id per concurrently-live barrier semaphore (Mosaic
+# requirement); the fused ring is the only in-kernel collective in the
+# package so a single id suffices.
+COLLECTIVE_ID = 7
+
+__all__ = [
+    "COLLECTIVE_ID",
+    "fitted_blocks",
+    "fused_ring_local",
+    "fused_ring_remote",
+    "remote_supported",
+]
+
+
+def remote_supported() -> bool:
+    """Does this jax expose the in-kernel remote-DMA surface we need?"""
+    return all(
+        hasattr(pltpu, name)
+        for name in (
+            "make_async_remote_copy",
+            "get_barrier_semaphore",
+            "semaphore_signal",
+            "semaphore_wait",
+            "SemaphoreType",
+            "DeviceIdType",
+        )
+    )
+
+
+def fitted_blocks(n_local: int, block_q: int | None, block_k: int | None):
+    """The (bq, bk) the fused kernel will actually run for ``n_local`` —
+    callers packing an int8 feed must quantize V at exactly this bk."""
+    return _block_sizes(n_local, n_local, block_q, block_k)
+
+
+# ---------------------------------------------------------------------------
+# Local tier: one launch over an all-gathered KV span
+# ---------------------------------------------------------------------------
+
+
+def _fused_local_kernel(origins_ref, his_ref, los_ref, works_ref, *refs,
+                        masked: bool, segmented: bool, quantized: bool,
+                        kpb: int, spans: int, scale: float,
+                        softclamp_value: float | None, bq: int, bk: int):
+    """Grid ``(b, h, n_q_blocks, hops * kpb)``; the innermost dimension is
+    the fused hop walk: ``s // kpb`` selects the hop (whose origin rank,
+    band offsets and work flag arrive via scalar prefetch), ``s % kpb``
+    the KV tile within that hop's block.  The ``(acc, m, l)`` scratch
+    persists across the whole walk — the scan path's inter-launch carry,
+    without the launches."""
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    scale_refs = None
+    if quantized:
+        scale_refs = refs[idx:idx + 3]
+        idx += 3
+    kvm_ref = refs[idx] if masked else None
+    idx += 1 if masked else 0
+    qseg_ref = kseg_ref = None
+    if segmented:
+        qseg_ref, kseg_ref = refs[idx:idx + 2]
+        idx += 2
+    out_ref, lse_ref = refs[idx:idx + 2]
+    acc, m, l = refs[idx + 2:]
+
+    s_id = pl.program_id(3)
+    hop = s_id // kpb
+    kb = s_id % kpb
+    qi = pl.program_id(2)
+
+    @pl.when(s_id == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, MASK_VALUE)
+        l[:] = jnp.zeros_like(l)
+
+    # Tile-level skip mirrors the scan path exactly: the per-hop work flag
+    # is `_hop_has_work`, the band predicate is `_tile_has_work` — so the
+    # fused walk touches the same tiles in the same order and parity can
+    # pin tight.  Sentinel offsets (+-n_local) make both checks vacuous
+    # for unbanded hops.
+    row0, col0 = qi * bq, kb * bk
+    hi, lo = his_ref[hop], los_ref[hop]
+    tile_live = (
+        (works_ref[hop] != 0)
+        & (col0 <= row0 + bq - 1 + hi)
+        & (col0 + bk - 1 >= row0 + lo)
+    )
+
+    @pl.when(tile_live)
+    def _tile():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if quantized:
+            # int8 QK^T: per-row q/k dequant scales ride the matmul's free
+            # indices; the softmax scale folds into the same rescale
+            # (docs/precision.md — identical to pallas_flash._fwd_tile).
+            qs_ref, ks_ref, _ = scale_refs
+            s = s * ((qs_ref[0, 0] * scale)[:, None] * ks_ref[0, 0][None, :])
+        elif scale != 1.0:
+            s = s * scale
+        if softclamp_value is not None:
+            s = jnp.tanh(s / softclamp_value) * softclamp_value
+
+        # Band mask in per-hop LOCAL coordinates — the same contract the
+        # scan path passes per launch as SMEM scalars, here indexed per
+        # hop from the prefetched schedule.
+        rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
+        cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
+        diff = cols - rows
+        keep = (diff <= hi) & (diff >= lo)
+        if masked:
+            keep = keep & kvm_ref[0][None, :]
+        if segmented:
+            keep = keep & (qseg_ref[0][:, None] == kseg_ref[0][None, :])
+        s = jnp.where(keep, s, MASK_VALUE)
+
+        _online_update(
+            s, v_ref[0, 0], acc, m, l,
+            v_scale=scale_refs[2][0, 0, 0] if quantized else None,
+        )
+
+    @pl.when(s_id == spans - 1)
+    def _write():
+        l_safe = jnp.maximum(l[:], EPSILON)
+        out_ref[0, 0] = (acc[:] / l_safe).astype(out_ref.dtype)
+        lse_ref[0, 0] = (m[:] + jnp.log(l_safe))[:, 0]
+
+
+def fused_ring_local(
+    q, k_all, v_all, kv_mask=None, *,
+    origins, his, los, works, n_local,
+    scale=1.0, softclamp_value=None,
+    block_q=None, block_k=None,
+    q_segment_ids=None, kv_segment_ids=None,
+    kv_quantized: QuantizedBlockKV | None = None,
+    interpret=None, name="fused_ring_local",
+):
+    """Fused-ring forward over a gathered KV span, one launch.
+
+    Args:
+      q: ``(b, h, n_local, d)`` — this rank's queries.  With
+        ``kv_quantized`` the QK^T side is still quantized per-row here
+        (the launcher quantizes q; k arrives pre-quantized in the feed).
+      k_all / v_all: ``(b, hk, n_total, d)`` gathered KV in ring order
+        (rank-major).  Ignored (may be the quantized values' dequant
+        twins) when ``kv_quantized`` is given.
+      kv_mask: optional ``(b, n_total)`` bool.
+      origins / his / los / works: ``(hops,)`` int32 hop schedule
+        (``parallel/ring.py::_fused_tables``) — origin rank per hop, band
+        offsets in per-hop local coordinates (sentinels ±n_local when
+        unbanded), live flag.
+      kv_quantized: PR 13's int8 kernel feed over the GATHERED span
+        (``quant.payload_kernel_feed`` / ``quant.quantize_kv_blocks``);
+        its ``block`` must equal the fitted bk (``fitted_blocks``).
+
+    Returns:
+      ``(out, lse)`` — ``(b, h, n_local, d)`` in q.dtype and
+      ``(b, h, n_local)`` f32, the fused-write contract of
+      ``pallas_flash`` (lse = m + log l).
+    """
+    b, h, n_q, d = q.shape
+    hk = k_all.shape[1]
+    if h % hk:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hk}")
+    g = h // hk
+    n_total = k_all.shape[2]
+    if n_q != n_local:
+        raise ValueError(f"q length {n_q} != n_local {n_local}")
+    if n_total % n_local:
+        raise ValueError(f"gathered span {n_total} not a multiple of {n_local}")
+    hops = int(origins.shape[0])
+
+    bq, bk = _block_sizes(n_local, n_local, block_q, block_k)
+    kpb = n_local // bk
+    spans = hops * kpb
+    nqb = n_q // bq
+
+    quantized = kv_quantized is not None
+    if quantized:
+        if kv_quantized.block != bk:
+            raise ValueError(
+                f"kv feed block {kv_quantized.block} != fitted bk {bk}; "
+                "pack with fitted_blocks()"
+            )
+        q_in, qs = _quant.quantize_rows(q)
+        k_in, ks = kv_quantized.k_q, kv_quantized.k_scale
+        v_in, vs = kv_quantized.v_q, kv_quantized.v_scale
+    else:
+        q_in, k_in, v_in = q, k_all, v_all
+        qs = ks = vs = None
+
+    segmented = q_segment_ids is not None
+    masked = kv_mask is not None
+    if masked:
+        kv_mask = kv_mask.astype(jnp.bool_)
+
+    def q_map(bi, hd, qi, s, o, hi, lo, w):
+        return (bi, hd, qi, 0)
+
+    def kv_map(bi, hd, qi, s, o, hi, lo, w):
+        return (bi, hd // g, o[s // kpb] * kpb + s % kpb, 0)
+
+    def kcol_map(bi, hd, qi, s, o, hi, lo, w):
+        return (bi, o[s // kpb] * kpb + s % kpb)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bk, d), kv_map),
+        pl.BlockSpec((1, 1, bk, d), kv_map),
+    ]
+    operands = [q_in, k_in, v_in]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda bi, hd, qi, s, o, hi, lo, w:
+                         (bi, hd, qi)),
+            pl.BlockSpec((1, 1, bk), lambda bi, hd, qi, s, o, hi, lo, w:
+                         (bi, hd // g, o[s // kpb] * kpb + s % kpb)),
+            pl.BlockSpec((1, 1, 1), lambda bi, hd, qi, s, o, hi, lo, w:
+                         (bi, hd // g, o[s // kpb] * kpb + s % kpb)),
+        ]
+        operands += [qs, ks, vs]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, bk), kcol_map))
+        operands.append(kv_mask)
+    if segmented:
+        in_specs.append(
+            pl.BlockSpec((1, bq), lambda bi, hd, qi, s, o, hi, lo, w:
+                         (bi, qi)))
+        in_specs.append(pl.BlockSpec((1, bk), kcol_map))
+        operands += [q_segment_ids, kv_segment_ids]
+
+    kernel = functools.partial(
+        _fused_local_kernel,
+        masked=masked, segmented=segmented, quantized=quantized,
+        kpb=kpb, spans=spans, scale=float(scale),
+        softclamp_value=softclamp_value, bq=bq, bk=bk,
+    )
+
+    tables = [jnp.asarray(t, jnp.int32) for t in (origins, his, los, works)]
+    unified = _unify_vma(*tables, *operands)
+    tables, operands = unified[:4], unified[4:]
+    like = operands[0]
+
+    if interpret is None:
+        interpret = _interpret_default()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, h, nqb, spans),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bq), lambda bi, hd, qi, s, o, hi, lo, w:
+                         (bi, hd, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((b, h, n_q, d), q.dtype, like),
+            _sds((b, h, n_q), jnp.float32, like),
+        ],
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+        name=name if not quantized else name + "_q8",
+    )(*tables, *operands)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Remote tier: in-kernel async ICI DMA, double-buffered
+# ---------------------------------------------------------------------------
+
+
+def _fused_remote_kernel(his_ref, los_ref, works_ref, nbrs_ref, *refs,
+                         quantized: bool, hops: int, bh: int, nqb: int,
+                         n_local: int, d: int, scale: float,
+                         softclamp_value: float | None, bq: int):
+    """Grid ``(hops, bh, n_q_blocks)`` — hop outermost so every tile of hop
+    ``i`` computes against ring-buffer slot ``i % 2`` before hop ``i+1``'s
+    arrival overwrites the other slot.  Per hop: the FIRST tile starts the
+    async push of the current slot to the next rank's alternate slot, every
+    tile computes from the current slot, and the LAST tile waits on the
+    DMA pair — the overlap window is the whole hop's compute."""
+    if quantized:
+        q_ref, qs_ref, k_ref, v_ref = refs[:4]
+        idx = 4
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        idx = 3
+    out_ref, lse_ref = refs[idx:idx + 2]
+    kvbuf, acc, m, l, send_sem, recv_sem = refs[idx + 2:]
+
+    hop = pl.program_id(0)
+    bhi = pl.program_id(1)
+    qi = pl.program_id(2)
+    cur = lax.rem(hop, 2)
+
+    @pl.when((hop == 0) & (bhi == 0) & (qi == 0))
+    def _seed():
+        # Local KV into slot 0, then a neighbor barrier: nobody pushes
+        # into a peer's alternate slot before that peer has seeded.
+        kvbuf[0, 0] = k_ref[...]
+        kvbuf[0, 1] = v_ref[...]
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=(nbrs_ref[0],))
+        pltpu.semaphore_signal(barrier, inc=1, device_id=(nbrs_ref[1],))
+        pltpu.semaphore_wait(barrier, 2)
+
+    def _copy(src_slot, dst_slot):
+        return pltpu.make_async_remote_copy(
+            src_ref=kvbuf.at[src_slot],
+            dst_ref=kvbuf.at[dst_slot],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=(nbrs_ref[1],),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    @pl.when(hop == 0)
+    def _init():
+        row0 = (bhi, pl.dslice(qi * bq, bq))
+        pl.store(acc, row0, jnp.zeros((bq, d), jnp.float32))
+        pl.store(m, row0, jnp.full((bq, 1), MASK_VALUE, jnp.float32))
+        pl.store(l, row0, jnp.zeros((bq, 1), jnp.float32))
+
+    @pl.when((bhi == 0) & (qi == 0) & (hop < hops - 1))
+    def _push():
+        # Static slot branches: the DMA descriptor's refs must be static.
+        @pl.when(cur == 0)
+        def _():
+            _copy(0, 1).start()
+
+        @pl.when(cur == 1)
+        def _():
+            _copy(1, 0).start()
+
+    @pl.when(
+        (works_ref[hop] != 0)
+        & (0 <= qi * bq + bq - 1 + his_ref[hop])
+        & (n_local - 1 >= qi * bq + los_ref[hop])
+    )
+    def _compute():
+        q = q_ref[0]
+        row = (bhi, pl.dslice(qi * bq, bq))
+        m_prev = pl.load(m, row)
+        l_prev = pl.load(l, row)
+        acc_prev = pl.load(acc, row)
+        if quantized:
+            kblk = pl.load(kvbuf, (cur, 0, bhi))
+            vblk = pl.load(kvbuf, (cur, 1, bhi))
+            k = kblk[:, :d]
+            ks = lax.bitcast_convert_type(
+                kblk[:, d:d + _quant.SCALE_BYTES], jnp.float32)
+            v = vblk[:, :d]
+            # pack_kv(v_block=n_local) broadcast the whole-block v scale
+            # to every row — row 0 recovers it.
+            vs = lax.bitcast_convert_type(
+                vblk[0, d:d + _quant.SCALE_BYTES], jnp.float32)
+        else:
+            k = pl.load(kvbuf, (cur, 0, bhi))
+            v = pl.load(kvbuf, (cur, 1, bhi))
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if quantized:
+            s = s * ((qs_ref[0] * scale)[:, None] * ks[None, :])
+        elif scale != 1.0:
+            s = s * scale
+        if softclamp_value is not None:
+            s = jnp.tanh(s / softclamp_value) * softclamp_value
+        rows = lax.broadcasted_iota(jnp.int32, (bq, n_local), 0) + qi * bq
+        cols = lax.broadcasted_iota(jnp.int32, (bq, n_local), 1)
+        diff = cols - rows
+        keep = (diff <= his_ref[hop]) & (diff >= los_ref[hop])
+        s = jnp.where(keep, s, MASK_VALUE)
+
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if quantized:
+            p8, p_scale = _quant.quantize_p(p)
+            # scale BEFORE the row-sum: never accumulate undequantized
+            # int8 content (precision auditor contract, docs/precision.md)
+            l_new = l_prev * alpha + jnp.sum(
+                p8.astype(jnp.float32) * p_scale, axis=1, keepdims=True)
+            pv = lax.dot_general(
+                p8, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * (p_scale * vs)
+        else:
+            l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+            pv = lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        pl.store(m, row, m_new)
+        pl.store(l, row, l_new)
+        pl.store(acc, row, acc_prev * alpha + pv)
+
+    @pl.when((bhi == bh - 1) & (qi == nqb - 1) & (hop < hops - 1))
+    def _wait():
+        @pl.when(cur == 0)
+        def _():
+            _copy(0, 1).wait()
+
+        @pl.when(cur == 1)
+        def _():
+            _copy(1, 0).wait()
+
+    @pl.when(hop == hops - 1)
+    def _write():
+        row = (bhi, pl.dslice(qi * bq, bq))
+        l_safe = jnp.maximum(pl.load(l, row), EPSILON)
+        out_ref[0] = (pl.load(acc, row) / l_safe).astype(out_ref.dtype)
+        lse_ref[0] = (pl.load(m, row) + jnp.log(l_safe))[:, 0]
+
+
+def fused_ring_remote(
+    q, k, v, *,
+    his, los, works, nbrs,
+    scale=1.0, softclamp_value=None, block_q=None,
+    payload=None, collective_id=COLLECTIVE_ID,
+    name="fused_ring_remote",
+):
+    """Fused-ring forward with in-kernel async remote KV circulation.
+
+    Call inside ``shard_map``: ``q`` ``(b, h, n_local, d)``, ``k``/``v``
+    ``(b, hk, n_local, d)`` are this rank's shards; ``nbrs`` is the int32
+    ``(2,)`` logical-neighbor pair ``[(rank-1) % W, (rank+1) % W]`` (safe
+    because ``torus_ring_order`` fed mesh construction — logical order IS
+    the physical snake).  KV is sent to ``rank+1`` each hop, so hop ``i``
+    holds origin ``(rank - i) % W`` — the same visit order as the scan
+    path, which is what makes ``his``/``los``/``works`` (from
+    ``_fused_tables``) directly reusable.
+
+    ``payload`` selects the int8 wire: a ``quant.pack_kv(k, v,
+    v_block=n_local)`` buffer ``(2, b, hk, n_local, d + SCALE_BYTES)``
+    circulates INSTEAD of k/v, dequant scales riding its trailing lanes;
+    q is quantized per-row here.  GQA is materialized (kv heads repeated
+    to h) before folding to ``(b*h, n, d)`` — the remote tier trades that
+    copy for whole-hop DMA granularity; masked/segmented configs take the
+    local tier instead.
+
+    TPU-execute only; traces on any backend (the contract row counts the
+    lowered ``dma_start``/semaphore ops from exactly this trace).
+    """
+    check_attention_args("fused_ring_remote", q, k, v, None,
+                         equal_qkv_len=True)
+    b, h, n_q, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    n_local = n_q
+    hops = int(his.shape[0])
+    quantized = payload is not None
+
+    bq, _ = _block_sizes(n_local, n_local, block_q, None)
+    nqb = n_local // bq
+    bh = b * h
+
+    def fold(x):
+        if x.shape[1] != h:
+            x = jnp.repeat(x, g, axis=1)
+        return x.reshape(bh, *x.shape[2:])
+
+    q_f = fold(q)
+    if quantized:
+        q8, qs = _quant.quantize_rows(q_f)
+        kv_f = jnp.stack([fold(payload[0]), fold(payload[1])], axis=1)
+        operands = [q8, qs, kv_f[:, 0], kv_f[:, 1]]
+        dd = d + _quant.SCALE_BYTES
+        kv_dtype = jnp.int8
+        in_specs = [
+            pl.BlockSpec((1, bq, d), lambda hop, bhi, qi, hi, lo, w, nb:
+                         (bhi, qi, 0)),
+            pl.BlockSpec((1, bq), lambda hop, bhi, qi, hi, lo, w, nb:
+                         (bhi, qi)),
+            pl.BlockSpec((bh, n_local, dd), lambda *a: (0, 0, 0)),
+            pl.BlockSpec((bh, n_local, dd), lambda *a: (0, 0, 0)),
+        ]
+    else:
+        k_f, v_f = fold(k), fold(v)
+        operands = [q_f, k_f, v_f]
+        dd = d
+        kv_dtype = k.dtype
+        in_specs = [
+            pl.BlockSpec((1, bq, d), lambda hop, bhi, qi, hi, lo, w, nb:
+                         (bhi, qi, 0)),
+            pl.BlockSpec((bh, n_local, d), lambda *a: (0, 0, 0)),
+            pl.BlockSpec((bh, n_local, d), lambda *a: (0, 0, 0)),
+        ]
+
+    kernel = functools.partial(
+        _fused_remote_kernel,
+        quantized=quantized, hops=hops, bh=bh, nqb=nqb,
+        n_local=n_local, d=d, scale=float(scale),
+        softclamp_value=softclamp_value, bq=bq,
+    )
+    tables = [jnp.asarray(t, jnp.int32) for t in (his, los, works, nbrs)]
+    unified = _unify_vma(*tables, *operands)
+    tables, operands = unified[:4], unified[4:]
+    like = operands[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(hops, bh, nqb),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda hop, bhi, qi, hi, lo, w, nb:
+                         (bhi, qi, 0)),
+            pl.BlockSpec((1, bq), lambda hop, bhi, qi, hi, lo, w, nb:
+                         (bhi, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, bh, n_local, dd), kv_dtype),
+            pltpu.VMEM((bh, n_local, d), jnp.float32),
+            pltpu.VMEM((bh, n_local, 1), jnp.float32),
+            pltpu.VMEM((bh, n_local, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out_f, lse_f = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((bh, n_local, d), q.dtype, like),
+            _sds((bh, n_local), jnp.float32, like),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+            collective_id=collective_id,
+        ),
+        interpret=False,
+        name=name if not quantized else name + "_q8",
+    )(*tables, *operands)
+    out = out_f.reshape(b, h, n_local, d)
+    lse = lse_f.reshape(b, h, n_local)
+    return out, lse

@@ -91,6 +91,8 @@ def hybrid_attention(
     ``bidirectional`` / ``dkv_dtype`` / ``counter_rotate`` /
     ``hop_compression`` / ``compute_dtype`` / ``impl``) pass straight
     through to the ring leg
+    (``impl="fused"`` runs the OUTER ring as the single-launch fused-ring
+    kernel, ops/pallas_ring.py — the a2a legs are unchanged)
     and mean what they mean there, with ``n_local`` read as the
     post-all-to-all chunk (``U x`` the resident shard) — in particular the
     TokenRing counter-rotation and int8 hop compression apply to the OUTER

@@ -87,6 +87,7 @@ from ..ops.pallas_flash import (
     pallas_flash_fused,
     pallas_flash_partials,
 )
+from ..ops import pallas_ring as _pallas_ring
 from ..ops import quant as _quant
 from .collectives import dequantize_ring_payload, quantize_ring_payload
 from ..utils.validate import check_attention_args
@@ -619,6 +620,139 @@ def _ring_fwd_pallas(
     return out, lse
 
 
+def _fused_tables(rank, passes, n_local, causal, striped, window, ring_size):
+    """Per-hop ``(origins, his, los, works)`` int32 tables for the fused
+    kernel — hop ``i`` visits origin ``(rank - i) % ring_size`` (the
+    scan path's unidirectional whole-block stream order, and the order the
+    remote tier's KV circulation produces by sending to ``rank + 1``).
+
+    Band offsets come from the SAME certified constructor the scan path
+    uses (:func:`_hop_offsets`), work flags from the same skip predicate
+    (:func:`_hop_has_work`); ``None`` (unbanded) lowers to the sentinels
+    ``hi = n_local`` / ``lo = -n_local``, vacuous over the in-kernel
+    ``j - i`` range ``(-n_local, n_local)``.  The coverage prover holds
+    these tables to the global-position oracle
+    (``analysis/coverage.py::prove_fused``)."""
+    origins, his, los, works = [], [], [], []
+    for i in range(passes):
+        origin = (rank - i) % ring_size
+        hi, lo = _hop_offsets(
+            rank, origin, n_local, causal, striped, window, ring_size
+        )
+        work = _hop_has_work(hi, lo, n_local, n_local)
+        origins.append(origin)
+        his.append(n_local if hi is None else hi)
+        los.append(-n_local if lo is None else lo)
+        works.append(work)
+
+    def stack(xs):
+        return jnp.stack([jnp.asarray(x).astype(jnp.int32) for x in xs])
+
+    return stack(origins), stack(his), stack(los), stack(works)
+
+
+def _gather_seq(x, axis_name, axis):
+    """All-gather a shard along its token axis, ring-order (rank-major)."""
+    if compat.axis_size(axis_name) == 1:
+        return x
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)  # ra: allow(RA004 the one caller wraps the gather in its ring/fused_gather scope)
+
+
+def _ring_fwd_fused(
+    q, k, v, kv_mask, segment_ids, axis_name, causal, striped, bucket_size,
+    passes, window, softclamp_value, scale, ring_size, rank, n_local,
+    hop_compression=None, compute_dtype=None,
+):
+    """Fused-ring forward: the WHOLE hop schedule in one kernel launch
+    (``ops/pallas_ring.py``), no per-hop dispatch, no ppermute.
+
+    Two tiers.  On TPU with remote-DMA support and an unmasked, unpacked
+    config, the remote tier circulates KV over ICI from inside the kernel
+    (``fused_ring_remote`` — async double-buffered
+    ``make_async_remote_copy`` per hop, overlap window = the whole hop's
+    compute).  Everything else — interpret/CPU parity runs, masked or
+    packed sequences — takes the local tier: one all-gather of the KV
+    span, then the same single launch walking the same hop tables
+    (``fused_ring_local``).  Both visit hops in scan-path order with
+    scan-path band offsets, so parity against ``_ring_fwd_pallas`` is
+    tile-order-exact.
+
+    int8 composition (PR 13): ``hop_compression="int8"`` +
+    ``compute_dtype="int8"`` feeds the kernel a ``pack_kv`` payload whose
+    dequant scales ride the circulated buffer (remote tier) or the
+    gathered feed (``payload_kernel_feed``, local tier); compression-only
+    configs round-trip KV through the wire codec first so wire precision
+    matches the scan path exactly.
+
+    The backward is the retained scan-path pallas ring (``_ring_vjp_bwd``
+    maps ``impl="fused"`` to ``"pallas"``): grads recompute from exact
+    residuals per hop and this forward's ``(out, lse)`` already uses the
+    flat pallas layout.
+    """
+    origins, his, los, works = _fused_tables(
+        rank, passes, n_local, causal, striped, window, ring_size
+    )
+    blk_q, blk_k = _pallas_blocks(bucket_size, n_local, n_local)
+    interpret = _pallas_ring._interpret_default()
+    q8 = compute_dtype == "int8"
+    wire8 = hop_compression is not None
+
+    remote_ok = (
+        not interpret
+        and _pallas_ring.remote_supported()
+        and kv_mask is None
+        and segment_ids is None
+        and q8 == wire8  # plain hops, or the fully-int8 wire+compute pair
+    )
+    if remote_ok:
+        nbrs = jnp.stack(
+            [(rank - 1) % ring_size, (rank + 1) % ring_size]
+        ).astype(jnp.int32)
+        payload = _quant.pack_kv(k, v, v_block=n_local) if q8 else None
+        with jax.named_scope("ring/fused"):
+            return _pallas_ring.fused_ring_remote(
+                q, k, v, his=his, los=los, works=works, nbrs=nbrs,
+                scale=scale, softclamp_value=softclamp_value,
+                block_q=blk_q, payload=payload,
+            )
+
+    if wire8 and not q8:
+        # wire-precision parity with the scan path: the compressed ring
+        # quantizes KV once at entry and dequantizes per hop — reproduce
+        # that codec round trip before gathering
+        k, v = dequantize_ring_payload(quantize_ring_payload(k, v), q.dtype)
+
+    with jax.named_scope("ring/fused_gather"):
+        k_all = _gather_seq(k, axis_name, 2)
+        v_all = _gather_seq(v, axis_name, 2)
+        mask_all = (None if kv_mask is None
+                    else _gather_seq(kv_mask, axis_name, 1))
+        seg_all = (None if segment_ids is None
+                   else _gather_seq(segment_ids, axis_name, 1))
+
+        kv_feed = None
+        if q8:
+            _, fit_k = _pallas_ring.fitted_blocks(n_local, blk_q, blk_k)
+            if wire8:
+                # the dequant-free composition: ONE pack at ring entry,
+                # scales ride the gathered payload straight into the kernel
+                payload = _quant.pack_kv(k, v, v_block=fit_k)
+                payload_all = _gather_seq(payload, axis_name, 3)
+                kv_feed = _quant.payload_kernel_feed(payload_all, fit_k)
+            if kv_feed is None:
+                kv_feed = _quant.quantize_kv_blocks(k_all, v_all, fit_k)
+
+    with jax.named_scope("ring/fused"):
+        return _pallas_ring.fused_ring_local(
+            q, k_all, v_all, mask_all,
+            origins=origins, his=his, los=los, works=works,
+            n_local=n_local, scale=scale, softclamp_value=softclamp_value,
+            block_q=blk_q, block_k=blk_k,
+            q_segment_ids=segment_ids, kv_segment_ids=seg_all,
+            kv_quantized=kv_feed, interpret=interpret,
+        )
+
+
 def _counter_static_band(i, n_local, causal, striped, window, ring_size):
     """Trace-time ``(full, band_hint)`` for counter-rotation hop ``i``.
 
@@ -990,7 +1124,14 @@ def ring_flash_attention(
         (ref ``ring_flash_attention.py:95-103``).
       window: exact sliding-window lookback in tokens (exact in both
         contiguous and striped layouts).
-      impl: per-hop compute path, ``"xla"`` or ``"pallas"``.
+      impl: compute path — ``"xla"`` / ``"pallas"`` run one flash call
+        per hop with a ``ppermute`` rotation between launches;
+        ``"fused"`` carries the WHOLE hop schedule inside one Pallas
+        launch (``ops/pallas_ring.py``: in-kernel async remote KV DMA on
+        TPU, gathered-span walk in interpret/CPU or masked/packed
+        configs), with the scan-path pallas backward retained.  Use
+        ``utils.resilience.resolve_ring_impl("auto")`` for recorded
+        degradation to the scan path where the fused tier is unavailable.
       bidirectional: circulate the two halves of each KV block in opposite
         ring directions (one ``ppermute`` each per hop).  Same totals, but
         the transfer rides both directions of the full-duplex ICI links, so
@@ -1060,11 +1201,27 @@ def ring_flash_attention(
             f"compute_dtype={compute_dtype!r}: supported values are None "
             '(model-dtype matmuls) and "int8" (quantized QK^T/PV)'
         )
-    if compute_dtype == "int8" and impl != "pallas":
+    if compute_dtype == "int8" and impl not in ("pallas", "fused"):
         raise ValueError(
             'compute_dtype="int8" runs on the Pallas kernels only — pass '
-            'impl="pallas" (the XLA flash path has no int8 matmul form)'
+            'impl="pallas" or impl="fused" (the XLA flash path has no '
+            "int8 matmul form)"
         )
+    if impl == "fused":
+        if counter_rotate:
+            raise ValueError(
+                'impl="fused" carries the whole hop schedule in one kernel '
+                "launch; the counter-rotation alternating Q/KV schedule "
+                'has no fused form — pass impl="pallas" with counter_rotate'
+            )
+        if bidirectional:
+            warnings.warn(
+                'impl="fused" circulates whole KV blocks inside the kernel '
+                "(the DMA is async either way); ignoring bidirectional "
+                "half-streams",
+                stacklevel=2,
+            )
+            bidirectional = False
     if counter_rotate and bidirectional:
         # a KV half-stream co-moving with the Q stream never advances its
         # pairing (docs/ring_overlap.md) — the schedules cannot compose,
@@ -1090,7 +1247,7 @@ def ring_flash_attention(
         from ..ops.flash import flash_attention
         from ..ops.pallas_flash import pallas_flash_attention
 
-        if impl == "pallas":
+        if impl in ("pallas", "fused"):
             return pallas_flash_attention(
                 q, k, v, kv_mask, causal=causal, window=window,
                 softclamp_value=softclamp_value, scale=scale,
@@ -1146,6 +1303,16 @@ def _ring_fwd_impl(
         out, lse = _counter_fwd(
             q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
             bucket_size, passes, window, softclamp_value, scale, impl,
+            ring_size, rank, n_local, hop_compression, compute_dtype,
+        )
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
+        return out, lse
+
+    if impl == "fused":
+        out, lse = _ring_fwd_fused(
+            q, k, v, kv_mask, segment_ids, axis_name, causal, striped,
+            bucket_size, passes, window, softclamp_value, scale,
             ring_size, rank, n_local, hop_compression, compute_dtype,
         )
         out = checkpoint_name(out, "flash_out")
@@ -1241,6 +1408,12 @@ def _ring_vjp_bwd(
     # the backward ignores compute_dtype this round: grads recompute
     # scores in bf16 from the EXACT residual (q, k, v) — only the
     # forward's (out, lse) carry int8 error (docs/precision.md §5)
+    if impl == "fused":
+        # the fused forward retains this scan-path backward: its lse is
+        # already the flat (b, h, n) pallas layout, grads recompute from
+        # the exact residuals hop by hop, and the fused forward always
+        # runs unidirectional (validation strips bidirectional)
+        impl = "pallas"
     q, k, v, kv_mask, segment_ids, out, lse = res
     b, h, n_local, d = q.shape
     hk = k.shape[1]

@@ -279,6 +279,12 @@ STAGES: list[tuple[str, str, str]] = [
     ("ring/catchup", "ring dkv catch-up", "transfer"),
     ("ring/bwd", "ring backward", "compute"),
     ("ring/hop", "ring hop compute", "compute"),
+    # fused ring (ops/pallas_ring.py): the CPU-degradable local tier's
+    # KV gather is transfer; the single launch itself is compute — its
+    # in-kernel remote DMAs never surface as separate timeline ops, which
+    # is exactly the launch-free-hops property (docs/ring_overlap.md)
+    ("ring/fused_gather", "fused ring kv gather", "transfer"),
+    ("ring/fused", "fused ring kernel", "compute"),
     ("kv_head_reshard", "gqa kv reshard", "transfer"),
     ("ulysses/a2a", "ulysses all-to-all", "transfer"),
     ("ulysses/flash", "ulysses local flash", "compute"),

@@ -607,15 +607,129 @@ def resolve_attention_impl(impl: str | None) -> str:
     )
 
 
+# fused-ring kernel (ops/pallas_ring.py): component name shared by the
+# probe, parallel/ring.py's dispatcher, and the models
+FUSED_COMPONENT = "fused_ring"
+# fault name the injection harness arms to force the fused path to fail
+FUSED_FAULT = "fused_fail"
+
+_fused_probe: bool | None = None
+
+
+def remote_copy_supported() -> bool:
+    """Does this jax expose the in-kernel remote-DMA surface the fused
+    ring's ICI tier needs (``pltpu.make_async_remote_copy`` + semaphore
+    primitives)?  Cheap attribute check, no compilation."""
+    from ..ops.pallas_ring import remote_supported
+
+    return remote_supported()
+
+
+def _probe_fused() -> None:
+    """Compile-and-run a minimal real (non-interpret) fused-ring launch.
+
+    Unlike the plain Pallas probe, a non-TPU backend here is a RECORDED
+    degradation, not a silent miss: ``impl="auto"`` via
+    :func:`resolve_ring_impl` promises the launch-free fused forward, and
+    falling back to the scan-path ring (per-hop launches + ppermutes) is
+    a real performance property change operators must be able to query.
+    The injected :data:`FUSED_FAULT` is checked first so CI can exercise
+    the degradation path anywhere.
+    """
+    get_injector().check(FUSED_FAULT)
+    import jax
+
+    if not remote_copy_supported():
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu lacks the remote-DMA surface "
+            "(make_async_remote_copy / semaphore primitives) — the fused "
+            "ring cannot circulate KV in-kernel on this jax version"
+        )
+    if jax.devices()[0].platform != "tpu":
+        raise RuntimeError(
+            f"backend {jax.devices()[0].platform!r} runs the fused ring "
+            "in interpret mode only — degrading to the scan-path ring"
+        )
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ring import fused_ring_local
+
+    q = jnp.zeros((1, 1, 128, 64), jnp.float32)
+    out, _ = fused_ring_local(
+        q, q, q,
+        origins=jnp.zeros((1,), jnp.int32),
+        his=jnp.zeros((1,), jnp.int32),
+        los=jnp.full((1,), -128, jnp.int32),
+        works=jnp.ones((1,), jnp.int32),
+        n_local=128, interpret=False,
+    )
+    jax.block_until_ready(out)
+
+
+def fused_ring_available(*, refresh: bool = False) -> bool:
+    """True when the real fused-ring kernel path works on this backend.
+
+    Probed once per process (cached, same lock discipline as
+    :func:`pallas_available`).  EVERY failure — CPU/interpret backend,
+    missing remote-copy support, Mosaic rejection, armed fault — records
+    a :data:`FUSED_COMPONENT` degradation with a one-shot warning (see
+    :func:`_probe_fused` for why non-TPU is not silent here)."""
+    global _fused_probe
+    with _pallas_probe_lock:
+        if _fused_probe is not None and not refresh:
+            return _fused_probe
+        try:
+            _probe_fused()
+            _fused_probe = True
+        except Exception as e:  # noqa: BLE001 — any failure means degrade
+            degradation.record(FUSED_COMPONENT, e)
+            _fused_probe = False
+        return _fused_probe
+
+
+def resolve_ring_impl(impl: str | None) -> str:
+    """Resolve a requested RING impl (superset of the attention impls).
+
+    ``"fused"`` returns ``"fused"`` when the probe passes, else records
+    the degradation (in the probe) and re-resolves as ``"auto"`` through
+    :func:`resolve_attention_impl` — the scan-path ring at the best
+    per-hop compute tier available.  ``"auto"`` prefers the fused tier,
+    then degrades the same way.  ``"xla"``/``"pallas"``/``None`` pass
+    through unchanged (explicit scan-path requests stay scan-path).
+
+    Note the asymmetry with :func:`ring_flash_attention`: calling it with
+    a literal ``impl="fused"`` always RUNS the fused kernel (interpret
+    mode on CPU — the parity-test tier); resolution here is the
+    model-level seam where interpret-mode would be a silent pessimization
+    rather than a test fixture.
+    """
+    if impl == "fused":
+        return "fused" if fused_ring_available() else (
+            resolve_attention_impl("auto")
+        )
+    if impl == "auto":
+        if (not degradation.is_degraded(FUSED_COMPONENT)
+                and fused_ring_available()):
+            return "fused"
+        return resolve_attention_impl("auto")
+    if impl in (None, "xla", "pallas"):
+        return resolve_attention_impl(impl)
+    raise ValueError(
+        f"resolve_ring_impl: impl must be 'auto', 'fused', 'pallas', "
+        f"'xla' or None, got {impl!r}"
+    )
+
+
 def reset(*, probe: bool = True) -> None:
     """Test-harness hook: clear armed faults, degradation state, and
-    (optionally) the cached Pallas probe result."""
-    global _pallas_probe
+    (optionally) the cached Pallas/fused-ring probe results."""
+    global _pallas_probe, _fused_probe
     _INJECTOR.clear()
     degradation.reset()
     if probe:
         with _pallas_probe_lock:
             _pallas_probe = None
+            _fused_probe = None
 
 
 # ----------------------------------------------------------------------

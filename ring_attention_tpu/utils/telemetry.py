@@ -82,6 +82,13 @@ ICI_GBPS = {
 FWD_MATMULS = 2
 FWDBWD_MATMULS = 7
 
+# per-launch dispatch cost the scan-path ring pays at every hop boundary
+# (host dispatch + Mosaic program setup, ~5us — the order XLA's launch
+# path costs on current TPU runtimes).  The fused ring's whole point is
+# that this term, and the launch boundary it models, do not exist: every
+# hop after the first starts inside the already-running kernel.
+DISPATCH_OVERHEAD_S = 5e-6
+
 
 # ----------------------------------------------------------------------
 # In-graph scalar collection
@@ -892,6 +899,7 @@ def ring_comms_accounting(
     counter_rotate: bool = False,
     hop_compression: str | None = None,
     compute_dtype: str | None = None,
+    impl: str = "scan",
 ) -> dict[str, Any]:
     """Topology-aware per-step communication accounting for a
     (ring x ulysses) sequence-parallel factoring (TASP, arXiv 2509.26541).
@@ -946,9 +954,39 @@ def ring_comms_accounting(
     transfer.  ``accumulator_bytes`` — the f32 ``(acc, m, l)`` state —
     is emitted under every compute_dtype and is invariant by
     construction: the contract the precision auditor proves.
+
+    ``impl`` selects the analytic execution model the numbers describe:
+
+    - ``"scan"`` (default) — one kernel launch per hop
+      (``parallel/ring.py``'s scanned/unrolled schedule): ``passes``
+      launches per forward, a :data:`DISPATCH_OVERHEAD_S` dispatch term
+      per launch, and the per-hop transfer exposed to the launch boundary
+      — the overlap denominator is
+      ``max(compute, transfer + dispatch)``, because a transfer finishing
+      inside the next launch's dispatch window hides nothing.
+    - ``"fused"`` — the single-launch fused ring
+      (``ops/pallas_ring.py``): the analytic hop count (``ring_hops``,
+      the data that must move) is IDENTICAL, but ``kernel_launches``
+      drops to 1, ``dispatch_overhead_s`` to 0.0, ``fwd_collectives`` to
+      0 (hops are in-kernel remote DMAs, not ppermutes — the contract
+      row pins this), and the overlap denominator loses the dispatch
+      term: ``max(compute, transfer)``, the model ``overlap_report``
+      holds a fused capture against.  ``counter_rotate`` has no fused
+      form and raises.
     """
     if heads is None:
         heads = kv_heads
+    if impl not in ("scan", "fused"):
+        raise ValueError(
+            f"ring_comms_accounting: impl={impl!r}; want \"scan\" (one "
+            'launch per hop) or "fused" (single-launch fused ring)'
+        )
+    if impl == "fused" and counter_rotate:
+        raise ValueError(
+            "ring_comms_accounting: counter_rotate has no fused form — "
+            "the alternating Q/KV schedule cannot ride one kernel launch "
+            '(parallel/ring.py raises on the same combination)'
+        )
     if hop_compression not in (None, "int8"):
         raise ValueError(
             f"ring_comms_accounting: hop_compression={hop_compression!r}; "
@@ -1054,7 +1092,17 @@ def ring_comms_accounting(
     # the counter schedule's worst rotation is whichever circulating
     # payload is larger (Q-pack vs KV handle); baseline it's the KV hop
     transfer_s = worst_hop_bytes / (ici_gbps * 1e9)
-    overlap = compute_s / max(compute_s, transfer_s, 1e-30)
+    # launch model: the scan path pays a dispatch boundary per hop that
+    # the transfer cannot hide behind; the fused ring has no boundary
+    if impl == "fused":
+        kernel_launches = 1
+        dispatch_overhead_s = 0.0
+        exposed_s = transfer_s
+    else:
+        kernel_launches = passes
+        dispatch_overhead_s = DISPATCH_OVERHEAD_S * passes
+        exposed_s = transfer_s + DISPATCH_OVERHEAD_S
+    overlap = compute_s / max(compute_s, exposed_s, 1e-30)
     # the matmul feed (per hop per device): q read once + the held k/v
     # span, at the compute operand width; the f32 (acc, m, l) state is
     # the invariant the precision auditor pins — never quantized
@@ -1064,7 +1112,15 @@ def ring_comms_accounting(
         + 2 * batch * kv_heads_local * n_chunk * dim_head
     ) * operand_bytes
     accumulator_bytes = 4 * batch * heads_local * n_chunk * (dim_head + 2)
+    if impl == "fused":
+        # hops are in-kernel remote DMAs: the forward issues ZERO
+        # ppermutes (analysis/contracts.py::check_fused_ring_contract);
+        # the backward retains the scan-path schedule (exact residuals)
+        fwd_collectives = 0
     return {
+        "impl": impl,
+        "kernel_launches": kernel_launches,
+        "dispatch_overhead_s": dispatch_overhead_s,
         "ring_size": ring_size,
         "ulysses_size": ulysses_size,
         "counter_rotate": counter_rotate,

@@ -644,6 +644,43 @@ def test_lint_ra011_signal_outside_elastic():
     ))
 
 
+def test_lint_ra013_remote_dma_outside_fused_kernel():
+    """RA013: remote-DMA / semaphore primitives outside the fused ring
+    kernel module flag with a one-line diagnostic (a second module
+    issuing raw semaphore ops can deadlock the ring and invalidates the
+    counted contract); the owning module and a reasoned allow are
+    clean."""
+    bad = (
+        "def hop(src, dst, s, r):\n"
+        "    copy = pltpu.make_async_remote_copy(src, dst, s, r,\n"
+        "                                        device_id=(1,))\n"
+        "    barrier = pltpu.get_barrier_semaphore()\n"
+        "    pltpu.semaphore_signal(barrier, inc=1, device_id=(0,))\n"
+        "    pltpu.semaphore_wait(barrier, 1)\n"
+        "    sem = pltpu.SemaphoreType.DMA\n"
+    )
+    violations = lint_source(bad, "ring_attention_tpu/parallel/newhop.py")
+    assert [v.rule for v in violations] == ["RA013"] * 5
+    assert "ops/pallas_ring.py" in violations[0].message
+    # the fused kernel module IS the seam
+    assert lint_source(bad, "ring_attention_tpu/ops/pallas_ring.py") == []
+    allowed = bad.replace(
+        "    pltpu.semaphore_wait(barrier, 1)\n",
+        "    pltpu.semaphore_wait(barrier, 1)  "
+        "# ra: allow(RA013 local-only probe, no ring peer waits on it)\n",
+    )
+    assert [v.rule for v in lint_source(
+        allowed, "ring_attention_tpu/parallel/newhop.py"
+    )] == ["RA013"] * 4
+    bare = bad.replace(
+        "    barrier = pltpu.get_barrier_semaphore()\n",
+        "    barrier = pltpu.get_barrier_semaphore()  # ra: allow(RA013)\n",
+    )
+    assert any("reason is mandatory" in v.message for v in lint_source(
+        bare, "ring_attention_tpu/parallel/newhop.py"
+    ))
+
+
 # ----------------------------------------------------------------------
 # Self-runs: the package itself is clean
 # ----------------------------------------------------------------------
@@ -663,9 +700,18 @@ def test_accumulator_dtype_audit_clean():
 
 def test_collective_fingerprint_shape(devices):
     """The bench-JSON fingerprint: per-strategy fwd collective counts,
-    cheap enough to ride along every bench round."""
+    cheap enough to ride along every bench round.  Since PR 18 the ring
+    row brings the fused-ring rows with it: the in-kernel remote-DMA /
+    semaphore counts from the lowered module, with ``ppermute: 0`` — the
+    launch-free-hops pin — for plain and int8-fed variants."""
     fp = contracts.collective_fingerprint(strategies=("ring",))
-    assert fp == {"ring": {"ppermute": 7}, "contract_ok": True}
+    fused_counts = dict(sorted(contracts.FUSED_RING_EXPECTED.items()))
+    assert fp == {
+        "ring": {"ppermute": 7},
+        "fused_ring": fused_counts,
+        "fused_ring_q8": fused_counts,
+        "contract_ok": True,
+    }
 
 
 # ----------------------------------------------------------------------

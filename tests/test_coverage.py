@@ -166,10 +166,14 @@ def test_coverage_fingerprint_deterministic_and_ok():
     assert fp1["coverage_ok"] is True
     assert fp1["single/causal"]["tiles"] == 36
     # every matrix row lands in the fingerprint — the fixed strategy x
-    # layout x masking rows, zig-zag, and the mask-algebra rows
-    assert set(fp1) - {"coverage_ok"} == {
-        c.name for c in coverage.CASES
-    } | {"zigzag/causal"} | {c.name for c in coverage.MASK_CASES}
+    # layout x masking rows, zig-zag, the mask-algebra rows, and the
+    # fused-ring table rows (PR 18)
+    assert set(fp1) - {"coverage_ok"} == (
+        {c.name for c in coverage.CASES}
+        | {"zigzag/causal"}
+        | {c.name for c in coverage.MASK_CASES}
+        | {c.name for c in coverage.FUSED_CASES}
+    )
 
 
 def test_gate_catches_coverage_regression(tmp_path):

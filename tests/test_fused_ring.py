@@ -1,0 +1,217 @@
+"""Parity: the single-launch fused ring vs the scan-path Pallas ring.
+
+``ring_flash_attention(impl="fused")`` carries the whole hop schedule —
+and its f32 ``(acc, m, l)`` online-softmax state — inside ONE Pallas
+launch (``ops/pallas_ring.py``), where the scan path runs one flash call
+per hop with a ``ppermute`` between launches.  Both paths accumulate in
+f32 over the SAME per-hop span partition, so on this container the fused
+forward is pinned BIT-EXACT against the scan path for plain / striped /
+windowed / packed / GQA / int8-wire configs (the int8 COMPUTE feed
+differs only by its per-row q requantization order, pinned at float
+tolerance).  The backward is the retained scan-path Pallas backward in
+both cases, so gradients are pinned exact too.
+
+On CPU the fused kernel runs in interpret mode when called explicitly
+(this file — the parity tier); the RESOLUTION seam
+(``utils.resilience.resolve_ring_impl``) instead records a
+``fused_ring`` degradation and falls back to the scan path, pinned at
+the end of this file.
+"""
+
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ring_attention_tpu.parallel import (
+    create_mesh,
+    ring_flash_attention,
+    stripe_permute,
+    stripe_unpermute,
+)
+from ring_attention_tpu.utils import resilience
+from ring_attention_tpu.utils.compat import shard_map
+
+# fused-vs-q8 forward: identical span schedule, q requantized per row in
+# both paths — only the fused path's in-kernel requant order differs
+Q8_ATOL = 1e-5
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(ring_size=4, data_size=2)
+
+
+def make_qkv(rng, b=2, h=4, hk=None, n=128, d=16):
+    hk = hk or h
+    q = jnp.asarray(rng.standard_normal((b, h, n, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hk, n, d)), jnp.float32)
+    return q, k, v
+
+
+def ring_attn(q, k, v, mask=None, seg=None, *, mesh, impl, striped=False,
+              **kw):
+    """Global-array harness: shard over (data, seq), run one impl."""
+    ring = mesh.shape["seq"]
+    if striped:
+        q = stripe_permute(q, ring, axis=2)
+        k = stripe_permute(k, ring, axis=2)
+        v = stripe_permute(v, ring, axis=2)
+
+    base = partial(
+        ring_flash_attention, axis_name="seq", causal=True,
+        striped=striped, bucket_size=32, impl=impl, **kw,
+    )
+    qspec = P("data", None, "seq", None)
+    mspec = P("data", "seq")
+    if seg is not None:
+        fn = lambda q, k, v, m, s: base(q, k, v, m, segment_ids=s)  # noqa: E731
+        specs = (qspec, qspec, qspec,
+                 mspec if mask is not None else P(), mspec)
+        operands = (q, k, v, mask, seg)
+    else:
+        fn = base
+        specs = (qspec, qspec, qspec, mspec if mask is not None else P())
+        operands = (q, k, v, mask)
+    out = shard_map(
+        fn, mesh=mesh,
+        in_specs=specs,
+        out_specs=qspec,
+        check_vma=False,  # device-varying scalars trip jax's vma checker
+    )(*operands)
+    if striped:
+        out = stripe_unpermute(out, ring, axis=2)
+    return out
+
+
+def assert_fused_matches_scan(rng, mesh, *, exact=True, atol=0.0, **kw):
+    """One config, both impls, same inputs — the parity pin."""
+    q, k, v = make_qkv(rng, hk=kw.pop("hk", None))
+    mask = kw.pop("mask", None)
+    seg = kw.pop("seg", None)
+    fused = ring_attn(q, k, v, mask, seg, mesh=mesh, impl="fused", **kw)
+    scan = ring_attn(q, k, v, mask, seg, mesh=mesh, impl="pallas", **kw)
+    if exact:
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(scan))
+    else:
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(scan), atol=atol
+        )
+
+
+def test_fused_plain(rng, mesh, devices):
+    assert_fused_matches_scan(rng, mesh)
+
+
+def test_fused_striped(rng, mesh, devices):
+    assert_fused_matches_scan(rng, mesh, striped=True)
+
+
+def test_fused_windowed(rng, mesh, devices):
+    assert_fused_matches_scan(rng, mesh, window=48)
+
+
+def test_fused_striped_windowed(rng, mesh, devices):
+    assert_fused_matches_scan(rng, mesh, striped=True, window=40)
+
+
+def test_fused_gqa(rng, mesh, devices):
+    assert_fused_matches_scan(rng, mesh, hk=2)
+
+
+def test_fused_key_padding(rng, mesh, devices):
+    mask = jnp.asarray(rng.random((2, 128)) > 0.3)
+    assert_fused_matches_scan(rng, mesh, mask=mask)
+
+
+def test_fused_packed_segments(rng, mesh, devices):
+    # 4 equal shard-aligned documents: the packed grid masks cross-doc
+    # pairs identically in both paths
+    seg = jnp.repeat(jnp.arange(4, dtype=jnp.int32), 32)[None, :]
+    seg = jnp.broadcast_to(seg, (2, 128))
+    assert_fused_matches_scan(rng, mesh, seg=seg)
+
+
+def test_fused_limited_passes(rng, mesh, devices):
+    assert_fused_matches_scan(rng, mesh, max_ring_passes=2, window=32)
+
+
+def test_fused_wire8(rng, mesh, devices):
+    # int8 HOP payload (PR 13 wire format): quantized once at ring entry,
+    # dequantized identically by both paths — still exact
+    assert_fused_matches_scan(rng, mesh, hop_compression="int8")
+
+
+def test_fused_q8_compute(rng, mesh, devices):
+    # int8 COMPUTE: both paths quantize q per row and feed int8 matmuls;
+    # only the fused kernel's in-kernel requant placement differs
+    assert_fused_matches_scan(
+        rng, mesh, exact=False, atol=Q8_ATOL, compute_dtype="int8",
+    )
+
+
+def test_fused_wire8_q8_compute(rng, mesh, devices):
+    # the dequant-free ring: one packed payload feeds every hop directly
+    assert_fused_matches_scan(
+        rng, mesh, exact=False, atol=Q8_ATOL,
+        hop_compression="int8", compute_dtype="int8",
+    )
+
+
+@pytest.mark.parametrize("kw", [{}, {"window": 48}, {"striped": True}])
+def test_fused_grads_match_scan(rng, mesh, devices, kw):
+    """The fused forward retains the scan-path Pallas backward — the
+    custom-vjp residuals it saves are the same ``(out, lse)`` contract,
+    so dq/dk/dv are pinned exact against the scan path."""
+    q, k, v = make_qkv(rng)
+
+    def loss(impl):
+        def f(q, k, v):
+            o = ring_attn(q, k, v, mesh=mesh, impl=impl, **kw)
+            return (o * o).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for gf, gs in zip(loss("fused"), loss("pallas")):
+        np.testing.assert_array_equal(np.asarray(gf), np.asarray(gs))
+
+
+def test_fused_resolution_degrades_on_cpu(devices):
+    """The resolution seam: on a backend without in-kernel remote copies
+    (this CPU container), ``resolve_ring_impl`` records a ``fused_ring``
+    degradation — one-shot warning, queryable event — and lands on the
+    scan path's own resolution; an explicit ``impl="fused"`` CALL still
+    runs (interpret mode — the tests above), the RESOLVER is the seam
+    models go through."""
+    resilience.reset()
+    try:
+        with pytest.warns(UserWarning, match="fused_ring degraded"):
+            resolved = resilience.resolve_ring_impl("fused")
+        assert resolved == "xla"  # CPU: the scan path resolves to XLA too
+        assert resilience.degradation.is_degraded(resilience.FUSED_COMPONENT)
+        events = resilience.degradation.events()
+        assert any(e.component == resilience.FUSED_COMPONENT for e in events)
+        # sticky: "auto" now skips the fused probe silently
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert resilience.resolve_ring_impl("auto") == "xla"
+    finally:
+        resilience.reset()
+
+
+def test_fused_fault_injection_degrades(devices):
+    """Armed ``FUSED_FAULT``: the probe fails before touching the kernel,
+    the degradation is recorded, and ``"auto"`` resolution falls back —
+    the chaos-harness hook for the fused tier."""
+    resilience.reset()
+    try:
+        with resilience.inject(resilience.FUSED_FAULT):
+            with pytest.warns(UserWarning, match="degraded"):
+                assert resilience.resolve_ring_impl("auto") == "xla"
+        assert resilience.degradation.is_degraded(resilience.FUSED_COMPONENT)
+    finally:
+        resilience.reset()

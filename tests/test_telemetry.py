@@ -465,6 +465,63 @@ def test_ring_comms_accounting_compute_dtype():
         ring_comms_accounting(compute_dtype="fp8", **kw)
 
 
+def test_ring_comms_accounting_fused():
+    """PR 18 terms as numbers.  ``impl="fused"``: the whole hop schedule
+    rides ONE kernel launch, so the launch count drops from ``passes`` to
+    1, the per-hop dispatch-overhead term vanishes, and the forward
+    issues ZERO XLA collectives (hops are in-kernel remote DMAs — the
+    ``fused_ring`` contract row pins the count from the lowered module).
+    Analytic HOPS and bytes are EQUAL to the scan path — the fused ring
+    moves the same KV the same number of times; what it deletes is the
+    launch boundary."""
+    kw = dict(ring_size=8, seq_len=8192, kv_heads=8, dim_head=64,
+              dtype_bytes=2)
+    scan = ring_comms_accounting(**kw)
+    fused = ring_comms_accounting(impl="fused", **kw)
+    assert scan["impl"] == "scan" and fused["impl"] == "fused"
+    # the launch model: one launch, no per-hop dispatch overhead
+    assert scan["kernel_launches"] == 8
+    assert fused["kernel_launches"] == 1
+    assert scan["dispatch_overhead_s"] > 0.0
+    assert fused["dispatch_overhead_s"] == 0.0
+    # hops are in-kernel remote DMAs, not XLA collectives
+    assert scan["fwd_collectives"] == 7
+    assert fused["fwd_collectives"] == 0
+    # the backward retains the scan-path schedule
+    assert fused["bwd_collectives"] == scan["bwd_collectives"]
+    # analytic hop/byte accounting is IDENTICAL — same KV, same moves
+    for key in ("ring_hops", "hop_bytes", "ring_bytes_per_step",
+                "ring_bytes_per_step_bwd"):
+        assert fused[key] == scan[key], key
+    # removing the exposed dispatch term can only improve overlap
+    assert fused["hop_overlap_fraction"] >= scan["hop_overlap_fraction"]
+    # limited passes: the scan path pays one launch per pass, fused one
+    limited = ring_comms_accounting(passes=3, **kw)
+    assert limited["kernel_launches"] == 3
+    assert ring_comms_accounting(
+        passes=3, impl="fused", **kw
+    )["kernel_launches"] == 1
+    with pytest.raises(ValueError, match="impl"):
+        ring_comms_accounting(impl="triton", **kw)
+    # counter-rotation has no fused form (parallel/ring.py raises on the
+    # same combination): the analytic model refuses it too
+    with pytest.raises(ValueError, match="counter_rotate"):
+        ring_comms_accounting(impl="fused", counter_rotate=True, **kw)
+
+
+def test_ring_comms_accounting_fused_north_star():
+    """The acceptance number: at the 262k north-star shape the fused
+    ring's measured-vs-analytic overlap target is ~1.0 — with the
+    dispatch term gone, per-hop compute fully hides the transfer."""
+    fused = ring_comms_accounting(
+        ring_size=8, seq_len=262144, kv_heads=8, dim_head=64,
+        dtype_bytes=2, impl="fused",
+    )
+    assert fused["hop_overlap_fraction"] == pytest.approx(1.0)
+    assert fused["kernel_launches"] == 1
+    assert fused["fwd_collectives"] == 0
+
+
 def test_train_memory_estimate_compute_dtype():
     """train_memory_estimate's int8 keys: operand bytes quarter from f32
     (halve from bf16), accumulator bytes invariant, peak untouched (the

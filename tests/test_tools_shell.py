@@ -104,6 +104,18 @@ def test_tpu_kernel_validate_q8_flag_parses():
     assert "--q8" in proc.stdout
 
 
+def test_tpu_kernel_validate_fused_flag_parses():
+    """``--fused`` (the single-launch fused-ring sweep, PR 18) must be a
+    real flag — same contract as ``--q8``: a broken flag is otherwise
+    only discovered when a scarce TPU window opens."""
+    proc = subprocess.run(
+        [sys.executable, KERNEL_VALIDATE, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--fused" in proc.stdout
+
+
 def test_trace_report_compiles():
     py_compile.compile(TRACE_REPORT, doraise=True)
 

@@ -52,6 +52,14 @@ def main() -> None:
                          "shape, then timed int8 fwd per (block, head-dim) "
                          "next to the bf16 rows — on silicon the int8 MXU "
                          "rate is ~2x bf16 peak (docs/precision.md)")
+    ap.add_argument("--fused", action="store_true",
+                    help="fused-ring sweep (PR 18): parity of the single-"
+                         "launch fused hop chain (ops/pallas_ring.py, "
+                         "in-kernel carry across hops) vs the scan-path "
+                         "span sequence and the dense oracle at the small "
+                         "shape, then a timed fused fwd per block size at "
+                         "--seq — the launch-boundary cost the fused path "
+                         "deletes, readable against the plain fwd rows")
     ap.add_argument("--hybrid", type=int, default=None, metavar="U",
                     help="hybrid Ulysses x Ring sweep: for every factoring "
                          "(u, r) of the available devices with u <= U, "
@@ -204,6 +212,35 @@ def main() -> None:
             ).max()),
             "q8_vs_oracle_max_err": float(jnp.abs(
                 q8_small.astype(jnp.float32) - oracle
+            ).max()),
+        }))
+
+    # ---- fused-ring parity (--fused): the single-launch hop chain for the
+    # causal last rank of a ring=4 slice of the parity shape, vs the same
+    # rows of the scan-path compact grid (both f32-accumulated Pallas —
+    # expected bit-exact) and the dense oracle
+    if args.fused:
+        from ring_attention_tpu.ops.pallas_ring import fused_ring_local
+        from ring_attention_tpu.parallel.ring import _fused_tables
+
+        f_ring = 4
+        f_n = n0 // f_ring
+        origins, his, los, works = _fused_tables(
+            f_ring - 1, f_ring, f_n, True, False, None, f_ring
+        )
+        fused_small = fused_ring_local(
+            q[:, :, -f_n:], k, v,
+            origins=origins, his=his, los=los, works=works,
+            n_local=f_n, scale=scale, interpret=args.interpret,
+        )[0]
+        print(json.dumps({
+            "mode": "fused-parity", "parity_seq": n0, "ring": f_ring,
+            "fused_vs_scan_max_err": float(jnp.abs(
+                fused_small.astype(jnp.float32)
+                - compact[:, :, -f_n:].astype(jnp.float32)
+            ).max()),
+            "fused_vs_oracle_max_err": float(jnp.abs(
+                fused_small.astype(jnp.float32) - oracle[:, :, -f_n:]
             ).max()),
         }))
 
@@ -420,6 +457,64 @@ def main() -> None:
                 "mode": "fwd-q8", "seq": seq, "dim_head": d128,
                 "error": f"{type(e).__name__}: {str(e)[:160]}",
             }))
+
+    # ---- fused-ring timed fwd (--fused): the ONE-launch hop chain at the
+    # target shape per (block_q, block_k), same span schedule and flop
+    # accounting as the plain fwd rows above — the row-to-row delta is
+    # the measured launch-boundary + carry-rematerialization cost the
+    # fused kernel deletes
+    if args.fused:
+        f_ring = 4
+        if seq % f_ring or (seq // f_ring) % 1024:
+            print(json.dumps({
+                "mode": "fused-fwd", "seq": seq,
+                "note": f"--seq must split into {f_ring} block-aligned "
+                        "shards for the fused timing",
+            }))
+        else:
+            f_n = seq // f_ring
+            tables_t = _fused_tables(
+                f_ring - 1, f_ring, f_n, True, False, None, f_ring
+            )
+
+            def fused_chained(bq, bk):
+                @jax.jit
+                def chained(qf, k, v):
+                    def body(c, _):
+                        o, _lse = fused_ring_local(
+                            c, k, v, origins=tables_t[0], his=tables_t[1],
+                            los=tables_t[2], works=tables_t[3],
+                            n_local=f_n, scale=scale, block_q=bq, block_k=bk,
+                            interpret=args.interpret,
+                        )
+                        return c + 1e-3 * o.astype(c.dtype), o[0, 0, 0, 0]
+                    _, ys = jax.lax.scan(body, qf, None, length=iters)
+                    return ys.astype(jnp.float32).sum()
+                return chained
+
+            qf = jax.random.normal(
+                jax.random.PRNGKey(6), (1, h, f_n, d), jnp.bfloat16
+            )
+            # last-rank causal work: half the diagonal span + R-1 full spans
+            flops_fused = 2 * 2 * h * d * f_n * f_n * (f_ring - 0.5)
+            for bq, bk in pairs:
+                try:
+                    compile_s, secs = timed_chained(
+                        fused_chained(bq, bk), (qf, k, v), iters
+                    )
+                    print(json.dumps({
+                        "mode": "fused-fwd", "seq": seq, "ring": f_ring,
+                        "block_q": bq, "block_k": bk, "kernel_launches": 1,
+                        "tflops": round(flops_fused / secs / 1e12, 4),
+                        "ms": round(secs * 1e3, 1),
+                        "compile_s": round(compile_s, 1),
+                    }))
+                except Exception as e:  # noqa: BLE001 - sweep survives rejects
+                    print(json.dumps({
+                        "mode": "fused-fwd", "seq": seq, "ring": f_ring,
+                        "block_q": bq, "block_k": bk,
+                        "error": f"{type(e).__name__}: {str(e)[:160]}",
+                    }))
 
     # ---- packed fwd timing: the trace-time doc skip vs plain causal at
     # the same shape (useful FLOPs shrink to the per-document triangles)
