@@ -958,19 +958,30 @@ def check_scan_contract(strategy: str, mesh=None, *, directions=None,
 # DIFFERENT surface from the scan-path contracts above: hops are in-kernel
 # remote DMAs, so the proof counts Mosaic DMA/semaphore primitives from the
 # traced kernel body instead of HLO collectives.  The counts are structural
-# (static ``pl.when`` branches over the double-buffer parity), so they are
-# ring-size independent: one copy-start + matching wait per buffer slot,
-# the neighbor barrier handshake, and — the launch-free-hops claim itself —
-# ZERO ppermutes anywhere in the forward.
+# (static ``pl.when`` branches, a once-traced ``fori_loop`` body), so they
+# are ring-size and shard-size independent.  Derivation against the kernel:
+#
+#   dma_start = 14:  2 seed (local KV -> slot 0)
+#                  + 2 remote push (one per static slot branch)
+#                  + 3 carry load (acc/m/l HBM -> VMEM)
+#                  + 4 kv staging (2 prologue + 2 in-loop prefetch)
+#                  + 3 carry store (acc/m/l VMEM -> HBM)
+#   dma_wait  = 14:  2 seed + 3 load + 2 kv staging + 3 store
+#                  + 4 remote (each remote wait drains send AND recv)
+#   semaphore_signal = 3:  2 seed barrier (left+right) + 1 grant to the
+#                          LEFT neighbor (the flow-control handshake)
+#   semaphore_wait   = 2:  1 seed barrier + 1 grant before the push
+#   get_barrier_semaphore = 1, and — the launch-free-hops claim itself —
+#   ZERO ppermutes anywhere in the forward.
 FUSED_RING_PRIMS = (
     "dma_start", "dma_wait", "semaphore_signal", "semaphore_wait",
     "get_barrier_semaphore", "ppermute",
 )
 FUSED_RING_EXPECTED = {
-    "dma_start": 2,
-    "dma_wait": 4,
-    "semaphore_signal": 2,
-    "semaphore_wait": 1,
+    "dma_start": 14,
+    "dma_wait": 14,
+    "semaphore_signal": 3,
+    "semaphore_wait": 2,
     "get_barrier_semaphore": 1,
     "ppermute": 0,
 }
@@ -1009,7 +1020,6 @@ def check_fused_ring_contract(
     buffer, never their own copy."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     from ..ops import pallas_ring
     from ..ops import quant as _quant
@@ -1031,17 +1041,17 @@ def check_fused_ring_contract(
                            jnp.float32)
 
     def core(q, k, v):
-        rank = lax.axis_index(SEQ_AXIS)
         his = jnp.full((ring,), n_local, jnp.int32)
         los = jnp.full((ring,), -n_local, jnp.int32)
         works = jnp.ones((ring,), jnp.int32)
-        nbrs = jnp.stack(
-            [(rank - 1) % ring, (rank + 1) % ring]
-        ).astype(jnp.int32)
+        # per-axis MESH coordinates — this mesh is multi-axis (data/dcn
+        # around the ring), exactly the shape where a ring-rank-only
+        # LOGICAL id would address the wrong replica group
+        nbr_coords = pallas_ring.neighbor_mesh_coords(SEQ_AXIS, ring)
         payload = (_quant.pack_kv(k, v, v_block=n_local)
                    if quantized else None)
         out, _ = pallas_ring.fused_ring_remote(
-            q, k, v, his=his, los=los, works=works, nbrs=nbrs,
+            q, k, v, his=his, los=los, works=works, nbr_coords=nbr_coords,
             scale=dim_head ** -0.5, payload=payload,
         )
         return out

@@ -32,8 +32,9 @@ The in-kernel carry above still costs one launch PER HOP;
 ``ops/pallas_ring.py`` builds on this module's seams (``_block_sizes``
 tile fitting, ``_online_update`` softmax algebra, the banded-offset mask
 contract) to run the WHOLE ring schedule as ONE launch — the next hop's
-KV double-buffered via in-kernel async remote DMA and ``(acc, m, l)``
-resident in VMEM scratch across hops.  ``impl="fused"`` on
+KV double-buffered via in-kernel async remote DMA, the ``(acc, m, l)``
+carry living in VMEM scratch (local tier) or staged per tile through an
+HBM spill (remote tier).  ``impl="fused"`` on
 ``ring_flash_attention`` selects it; the backward retains this module's
 two-pass kernels.
 """

@@ -20,16 +20,25 @@ boundary itself, in two tiers:
 
 ``fused_ring_remote``
     The ICI tier: the kernel itself double-buffers the NEXT rank's KV
-    block via async remote DMA (``pltpu.make_async_remote_copy`` into the
-    alternate slot of a VMEM scratch ring buffer, barrier + DMA semaphores
-    riding the same buffer) while the current hop's tiles compute.
-    Neighbor coordinates come from ``parallel/mesh.py::torus_ring_order``
-    feeding mesh construction, so logical neighbor ids ARE physical ICI
-    neighbors.  With an int8 ``pack_kv`` payload the per-row dequant
-    scales travel inside the circulated buffer (bitcast into the trailing
-    ``SCALE_BYTES`` lanes), so quantized hops need no side-channel
-    collective.  Executes on TPU only; on CPU it still *traces* — which is
-    how ``analysis/contracts.py`` counts the in-kernel ``dma_start`` /
+    shard via async remote DMA (``pltpu.make_async_remote_copy`` into the
+    alternate slot of an HBM ring buffer) while the current hop's tiles
+    compute.  The circulated buffer and the cross-hop ``(acc, m, l)``
+    carry are HBM-resident — compute stages tile-sized blocks through
+    VMEM scratch, so the kernel fits arbitrary ``n_local`` — and each
+    push is gated by a receiver-to-sender GRANT semaphore (the receiver
+    signals its left neighbor once it has drained a slot's last read, so
+    compute skew under causal ``works`` schedules can never let a DMA
+    overwrite KV mid-read).  Remote descriptors address neighbors by
+    per-axis MESH coordinates (:func:`neighbor_mesh_coords`), varying
+    only the ring axis — correct on multi-axis (data × seq, hybrid DCN)
+    meshes where a ring-rank-only LOGICAL id would target the wrong
+    replica group; physical ICI adjacency holds because
+    ``parallel/mesh.py::torus_ring_order`` fed mesh construction.  With
+    an int8 ``pack_kv`` payload the per-row dequant scales travel inside
+    the circulated buffer (bitcast into the trailing ``SCALE_BYTES``
+    lanes), so quantized hops need no side-channel collective.  Executes
+    on TPU only; on CPU it still *traces* — which is how
+    ``analysis/contracts.py`` counts the in-kernel ``dma_start`` /
     semaphore primitives and proves the forward carries zero ppermutes.
 
 Both tiers share ``ops/pallas_flash.py``'s tile math (``_online_update``)
@@ -71,6 +80,7 @@ __all__ = [
     "fitted_blocks",
     "fused_ring_local",
     "fused_ring_remote",
+    "neighbor_mesh_coords",
     "remote_supported",
 ]
 
@@ -80,6 +90,7 @@ def remote_supported() -> bool:
     return all(
         hasattr(pltpu, name)
         for name in (
+            "make_async_copy",
             "make_async_remote_copy",
             "get_barrier_semaphore",
             "semaphore_signal",
@@ -348,39 +359,98 @@ def fused_ring_local(
 # ---------------------------------------------------------------------------
 
 
+def neighbor_mesh_coords(axis_name, ring_size: int):
+    """``(2, naxes)`` int32 MESH coordinates of the ``[left, right]`` ring
+    neighbors — per-axis indices over EVERY bound mesh axis, varying only
+    along ``axis_name``.
+
+    The remote-DMA/semaphore primitives take ``DeviceIdType.MESH``
+    coordinates: the Mosaic lowering linearizes them over the WHOLE mesh
+    (``coord . strides`` in mesh-axis order), so on a mesh with axes
+    beyond the ring (``data``, ``dcn``, hybrid's node axis) every replica
+    addresses the neighbor in its OWN replica group.  A bare ring-axis
+    index with ``DeviceIdType.LOGICAL`` — the obvious spelling — is wrong
+    there: logical ids span the full mesh, and every replica outside the
+    first row would push its KV into a different replica group.
+
+    Returns ``None`` when the bound axes cannot be introspected (exotic
+    jax) or ``axis_name`` is not a single bound axis — callers degrade to
+    the gather-based local tier.
+    """
+    names = compat.bound_axis_names()
+    if names is None:
+        return None
+    try:
+        if axis_name not in names:
+            return None
+    except TypeError:  # tuple-of-axes collectives have no single ring axis
+        return None
+    rank = lax.axis_index(axis_name)
+    rows = []
+    for nbr in ((rank - 1) % ring_size, (rank + 1) % ring_size):
+        rows.append(jnp.stack([
+            jnp.asarray(nbr if a == axis_name else lax.axis_index(a))
+            for a in names
+        ]))
+    return jnp.stack(rows).astype(jnp.int32)
+
+
 def _fused_remote_kernel(his_ref, los_ref, works_ref, nbrs_ref, *refs,
-                         quantized: bool, hops: int, bh: int, nqb: int,
-                         n_local: int, d: int, scale: float,
-                         softclamp_value: float | None, bq: int):
+                         quantized: bool, hops: int, naxes: int, bh: int,
+                         nqb: int, n_local: int, d: int, scale: float,
+                         softclamp_value: float | None, bq: int, bk: int):
     """Grid ``(hops, bh, n_q_blocks)`` — hop outermost so every tile of hop
-    ``i`` computes against ring-buffer slot ``i % 2`` before hop ``i+1``'s
-    arrival overwrites the other slot.  Per hop: the FIRST tile starts the
-    async push of the current slot to the next rank's alternate slot, every
-    tile computes from the current slot, and the LAST tile waits on the
-    DMA pair — the overlap window is the whole hop's compute."""
+    ``i`` computes against HBM ring-buffer slot ``i % 2`` while hop
+    ``i+1``'s payload streams into the other slot.  Per hop: the FIRST
+    tile starts the async HBM->HBM push of the current slot to the next
+    rank's alternate slot, every tile stages ``(bq, bk)`` blocks of the
+    current slot through VMEM and folds them into its ``(acc, m, l)``
+    carry (itself staged per-tile through VMEM from an HBM spill buffer —
+    the carry for the whole shard cannot be VMEM-resident at model
+    sizes), and the LAST tile waits on the DMA pair — the overlap window
+    is the whole hop's compute.
+
+    Cross-device flow control is a receiver->sender grant: finishing hop
+    ``i`` (all tiles computed, outbound send of slot ``i % 2`` drained)
+    signals the LEFT neighbor's ``grant_sem``; that neighbor must consume
+    one grant before its hop ``i+1`` push, which targets exactly the slot
+    hop ``i`` was reading.  Without it a one-hop compute skew — guaranteed
+    under causal schedules, where per-rank live-hop counts differ — would
+    let the incoming DMA overwrite KV mid-read."""
     if quantized:
-        q_ref, qs_ref, k_ref, v_ref = refs[:4]
+        q_ref, qs_ref, k_src, v_src = refs[:4]
         idx = 4
     else:
-        q_ref, k_ref, v_ref = refs[:3]
+        q_ref, k_src, v_src = refs[:3]
         idx = 3
     out_ref, lse_ref = refs[idx:idx + 2]
-    kvbuf, acc, m, l, send_sem, recv_sem = refs[idx + 2:]
+    kvbuf, accb, mb, lb = refs[idx + 2:idx + 6]
+    (kvv, acc, m, l, load_sem, kv_sems, send_sem, recv_sem,
+     grant_sem) = refs[idx + 6:]
 
     hop = pl.program_id(0)
     bhi = pl.program_id(1)
     qi = pl.program_id(2)
     cur = lax.rem(hop, 2)
 
+    def nbr(row):
+        # MESH coords over every mesh axis — see neighbor_mesh_coords.
+        return tuple(nbrs_ref[row, a] for a in range(naxes))
+
     @pl.when((hop == 0) & (bhi == 0) & (qi == 0))
     def _seed():
-        # Local KV into slot 0, then a neighbor barrier: nobody pushes
-        # into a peer's alternate slot before that peer has seeded.
-        kvbuf[0, 0] = k_ref[...]
-        kvbuf[0, 1] = v_ref[...]
+        # Local KV into slot 0 (HBM->HBM), then a neighbor barrier:
+        # nobody pushes into a peer's alternate slot before that peer has
+        # seeded.
+        for part, src in enumerate((k_src, v_src)):
+            cp = pltpu.make_async_copy(src, kvbuf.at[0, part], load_sem)
+            cp.start()
+            cp.wait()
         barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id=(nbrs_ref[0],))
-        pltpu.semaphore_signal(barrier, inc=1, device_id=(nbrs_ref[1],))
+        pltpu.semaphore_signal(barrier, inc=1, device_id=nbr(0),
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=nbr(1),
+                               device_id_type=pltpu.DeviceIdType.MESH)
         pltpu.semaphore_wait(barrier, 2)
 
     def _copy(src_slot, dst_slot):
@@ -389,19 +459,20 @@ def _fused_remote_kernel(his_ref, los_ref, works_ref, nbrs_ref, *refs,
             dst_ref=kvbuf.at[dst_slot],
             send_sem=send_sem,
             recv_sem=recv_sem,
-            device_id=(nbrs_ref[1],),
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=nbr(1),
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
-
-    @pl.when(hop == 0)
-    def _init():
-        row0 = (bhi, pl.dslice(qi * bq, bq))
-        pl.store(acc, row0, jnp.zeros((bq, d), jnp.float32))
-        pl.store(m, row0, jnp.full((bq, 1), MASK_VALUE, jnp.float32))
-        pl.store(l, row0, jnp.zeros((bq, 1), jnp.float32))
 
     @pl.when((bhi == 0) & (qi == 0) & (hop < hops - 1))
     def _push():
+        # Flow control: the hop-i push writes the neighbor's slot
+        # (i+1) % 2 — the slot it reads during its hop i-1.  One grant ==
+        # "I finished hop i-1"; hop 0's target slot has never been read,
+        # so only the seed barrier gates it.
+        @pl.when(hop > 0)
+        def _flow():
+            pltpu.semaphore_wait(grant_sem, 1)
+
         # Static slot branches: the DMA descriptor's refs must be static.
         @pl.when(cur == 0)
         def _():
@@ -411,68 +482,112 @@ def _fused_remote_kernel(his_ref, los_ref, works_ref, nbrs_ref, *refs,
         def _():
             _copy(1, 0).start()
 
-    @pl.when(
+    row0 = qi * bq
+    live = (
         (works_ref[hop] != 0)
-        & (0 <= qi * bq + bq - 1 + his_ref[hop])
-        & (n_local - 1 >= qi * bq + los_ref[hop])
+        & (0 <= row0 + bq - 1 + his_ref[hop])
+        & (n_local - 1 >= row0 + los_ref[hop])
     )
+    state = ((accb, acc), (mb, m), (lb, l))
+
+    @pl.when(hop == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, MASK_VALUE)
+        l[:] = jnp.zeros_like(l)
+
+    @pl.when((hop > 0) & (live | (hop == hops - 1)))
+    def _load_state():
+        cps = [
+            pltpu.make_async_copy(
+                hb.at[bhi, pl.dslice(row0, bq)], vref, load_sem)
+            for hb, vref in state
+        ]
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+
+    @pl.when(live)
     def _compute():
         q = q_ref[0]
-        row = (bhi, pl.dslice(qi * bq, bq))
-        m_prev = pl.load(m, row)
-        l_prev = pl.load(l, row)
-        acc_prev = pl.load(acc, row)
-        if quantized:
-            kblk = pl.load(kvbuf, (cur, 0, bhi))
-            vblk = pl.load(kvbuf, (cur, 1, bhi))
-            k = kblk[:, :d]
-            ks = lax.bitcast_convert_type(
-                kblk[:, d:d + _quant.SCALE_BYTES], jnp.float32)
-            v = vblk[:, :d]
-            # pack_kv(v_block=n_local) broadcast the whole-block v scale
-            # to every row — row 0 recovers it.
-            vs = lax.bitcast_convert_type(
-                vblk[0, d:d + _quant.SCALE_BYTES], jnp.float32)
-        else:
-            k = pl.load(kvbuf, (cur, 0, bhi))
-            v = pl.load(kvbuf, (cur, 1, bhi))
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if quantized:
-            s = s * ((qs_ref[0] * scale)[:, None] * ks[None, :])
-        elif scale != 1.0:
-            s = s * scale
-        if softclamp_value is not None:
-            s = jnp.tanh(s / softclamp_value) * softclamp_value
-        rows = lax.broadcasted_iota(jnp.int32, (bq, n_local), 0) + qi * bq
-        cols = lax.broadcasted_iota(jnp.int32, (bq, n_local), 1)
-        diff = cols - rows
-        keep = (diff <= his_ref[hop]) & (diff >= los_ref[hop])
-        s = jnp.where(keep, s, MASK_VALUE)
+        hi, lo = his_ref[hop], los_ref[hop]
+        # Only the KV blocks the band touches: rows [row0, row0+bq) keep
+        # cols j with lo <= j - i <= hi, clamped to the shard.  `live`
+        # guarantees a non-empty range.
+        kb_lo = jnp.maximum(row0 + lo, 0) // bk
+        kb_hi = jnp.minimum(row0 + bq - 1 + hi, n_local - 1) // bk
 
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        if quantized:
-            p8, p_scale = _quant.quantize_p(p)
-            # scale BEFORE the row-sum: never accumulate undequantized
-            # int8 content (precision auditor contract, docs/precision.md)
-            l_new = l_prev * alpha + jnp.sum(
-                p8.astype(jnp.float32) * p_scale, axis=1, keepdims=True)
-            pv = lax.dot_general(
-                p8, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * (p_scale * vs)
-        else:
-            l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-            pv = lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        def kv_copies(kb, buf):
+            # Double-buffered HBM->VMEM staging of one (bk, dd) K and V
+            # block of the CURRENT slot; per-buffer DMA semaphore.
+            return [
+                pltpu.make_async_copy(
+                    kvbuf.at[cur, part, bhi, pl.dslice(kb * bk, bk)],
+                    kvv.at[buf, part],
+                    kv_sems.at[buf],
+                )
+                for part in (0, 1)
+            ]
+
+        for cp in kv_copies(kb_lo, 0):
+            cp.start()
+
+        def body(i, carry):
+            kb = kb_lo + i
+            buf = lax.rem(i, 2)
+
+            @pl.when(kb < kb_hi)
+            def _prefetch():
+                for cp in kv_copies(kb + 1, 1 - buf):
+                    cp.start()
+
+            for cp in kv_copies(kb, buf):
+                cp.wait()
+
+            kblk = kvv[buf, 0]
+            vblk = kvv[buf, 1]
+            k = kblk[:, :d] if quantized else kblk
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        pl.store(m, row, m_new)
-        pl.store(l, row, l_new)
-        pl.store(acc, row, acc_prev * alpha + pv)
+            if quantized:
+                ks = lax.bitcast_convert_type(
+                    kblk[:, d:d + _quant.SCALE_BYTES], jnp.float32)
+                s = s * ((qs_ref[0] * scale)[:, None] * ks[None, :])
+            elif scale != 1.0:
+                s = s * scale
+            if softclamp_value is not None:
+                s = jnp.tanh(s / softclamp_value) * softclamp_value
+            rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
+            cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kb * bk
+            diff = cols - rows
+            keep = (diff <= hi) & (diff >= lo)
+            s = jnp.where(keep, s, MASK_VALUE)
+            if quantized:
+                # pack_kv(v_block=n_local) broadcast the whole-block v
+                # scale to every row — row 0 of any slice recovers it.
+                vs = lax.bitcast_convert_type(
+                    vblk[0, d:d + _quant.SCALE_BYTES], jnp.float32)
+                _online_update(s, vblk[:, :d], acc, m, l, v_scale=vs)
+            else:
+                _online_update(s, vblk, acc, m, l)
+            return carry
+
+        lax.fori_loop(0, kb_hi - kb_lo + 1, body, 0)
+
+    @pl.when((hop < hops - 1) & (live | (hop == 0)))
+    def _store_state():
+        cps = [
+            pltpu.make_async_copy(
+                vref, hb.at[bhi, pl.dslice(row0, bq)], load_sem)
+            for hb, vref in state
+        ]
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
 
     @pl.when((bhi == bh - 1) & (qi == nqb - 1) & (hop < hops - 1))
     def _wait():
@@ -484,31 +599,48 @@ def _fused_remote_kernel(his_ref, los_ref, works_ref, nbrs_ref, *refs,
         def _():
             _copy(1, 0).wait()
 
+        # Slot `cur` is now dead here (every tile computed, outbound send
+        # drained just above): grant the LEFT neighbor's next push — it
+        # targets exactly this slot.  The last granted push is hop
+        # hops-2, consuming the grant from hop hops-3: signals and waits
+        # balance, the semaphore drains to zero.
+        @pl.when(hop < hops - 2)
+        def _grant():
+            pltpu.semaphore_signal(grant_sem, inc=1, device_id=nbr(0),
+                                   device_id_type=pltpu.DeviceIdType.MESH)
+
     @pl.when(hop == hops - 1)
     def _write():
-        row = (bhi, pl.dslice(qi * bq, bq))
-        l_safe = jnp.maximum(pl.load(l, row), EPSILON)
-        out_ref[0] = (pl.load(acc, row) / l_safe).astype(out_ref.dtype)
-        lse_ref[0] = (pl.load(m, row) + jnp.log(l_safe))[:, 0]
+        l_safe = jnp.maximum(l[:], EPSILON)
+        out_ref[0] = (acc[:] / l_safe).astype(out_ref.dtype)
+        lse_ref[0] = (m[:] + jnp.log(l_safe))[:, 0]
 
 
 def fused_ring_remote(
     q, k, v, *,
-    his, los, works, nbrs,
-    scale=1.0, softclamp_value=None, block_q=None,
+    his, los, works, nbr_coords,
+    scale=1.0, softclamp_value=None, block_q=None, block_k=None,
     payload=None, collective_id=COLLECTIVE_ID,
     name="fused_ring_remote",
 ):
     """Fused-ring forward with in-kernel async remote KV circulation.
 
     Call inside ``shard_map``: ``q`` ``(b, h, n_local, d)``, ``k``/``v``
-    ``(b, hk, n_local, d)`` are this rank's shards; ``nbrs`` is the int32
-    ``(2,)`` logical-neighbor pair ``[(rank-1) % W, (rank+1) % W]`` (safe
-    because ``torus_ring_order`` fed mesh construction — logical order IS
-    the physical snake).  KV is sent to ``rank+1`` each hop, so hop ``i``
-    holds origin ``(rank - i) % W`` — the same visit order as the scan
-    path, which is what makes ``his``/``los``/``works`` (from
+    ``(b, hk, n_local, d)`` are this rank's shards; ``nbr_coords`` is the
+    int32 ``(2, naxes)`` MESH-coordinate pair of the ``[rank-1, rank+1]``
+    ring neighbors over EVERY mesh axis (:func:`neighbor_mesh_coords` —
+    physical adjacency holds because ``torus_ring_order`` fed mesh
+    construction).  KV is sent to ``rank+1`` each hop, so hop ``i`` holds
+    origin ``(rank - i) % W`` — the same visit order as the scan path,
+    which is what makes ``his``/``los``/``works`` (from
     ``_fused_tables``) directly reusable.
+
+    The circulated double buffer and the cross-hop ``(acc, m, l)`` carry
+    live in HBM (``ANY``-space buffers the caller discards); compute
+    stages ``(bq, bk)`` blocks and per-tile carries through small VMEM
+    scratch, so VMEM footprint is tile-sized and independent of
+    ``n_local`` — whole-shard VMEM residency does not compile at model
+    sizes (32k-token shards are hundreds of MB against ~16 MB of VMEM).
 
     ``payload`` selects the int8 wire: a ``quant.pack_kv(k, v,
     v_block=n_local)`` buffer ``(2, b, hk, n_local, d + SCALE_BYTES)``
@@ -528,9 +660,10 @@ def fused_ring_remote(
     g = h // hk
     n_local = n_q
     hops = int(his.shape[0])
+    naxes = int(nbr_coords.shape[-1])
     quantized = payload is not None
 
-    bq, _ = _block_sizes(n_local, n_local, block_q, None)
+    bq, bk = _block_sizes(n_local, n_local, block_q, block_k)
     nqb = n_local // bq
     bh = b * h
 
@@ -540,10 +673,10 @@ def fused_ring_remote(
         return x.reshape(bh, *x.shape[2:])
 
     q_f = fold(q)
+    hbm = pl.BlockSpec(memory_space=pltpu.ANY)
     if quantized:
         q8, qs = _quant.quantize_rows(q_f)
-        kv_f = jnp.stack([fold(payload[0]), fold(payload[1])], axis=1)
-        operands = [q8, qs, kv_f[:, 0], kv_f[:, 1]]
+        operands = [q8, qs, fold(payload[0]), fold(payload[1])]
         dd = d + _quant.SCALE_BYTES
         kv_dtype = jnp.int8
         in_specs = [
@@ -551,28 +684,28 @@ def fused_ring_remote(
                          (bhi, qi, 0)),
             pl.BlockSpec((1, bq), lambda hop, bhi, qi, hi, lo, w, nb:
                          (bhi, qi)),
-            pl.BlockSpec((bh, n_local, dd), lambda *a: (0, 0, 0)),
-            pl.BlockSpec((bh, n_local, dd), lambda *a: (0, 0, 0)),
+            hbm,
+            hbm,
         ]
     else:
-        k_f, v_f = fold(k), fold(v)
-        operands = [q_f, k_f, v_f]
+        operands = [q_f, fold(k), fold(v)]
         dd = d
         kv_dtype = k.dtype
         in_specs = [
             pl.BlockSpec((1, bq, d), lambda hop, bhi, qi, hi, lo, w, nb:
                          (bhi, qi, 0)),
-            pl.BlockSpec((bh, n_local, d), lambda *a: (0, 0, 0)),
-            pl.BlockSpec((bh, n_local, d), lambda *a: (0, 0, 0)),
+            hbm,
+            hbm,
         ]
 
     kernel = functools.partial(
         _fused_remote_kernel,
-        quantized=quantized, hops=hops, bh=bh, nqb=nqb,
+        quantized=quantized, hops=hops, naxes=naxes, bh=bh, nqb=nqb,
         n_local=n_local, d=d, scale=float(scale),
-        softclamp_value=softclamp_value, bq=bq,
+        softclamp_value=softclamp_value, bq=bq, bk=bk,
     )
-    tables = [jnp.asarray(t, jnp.int32) for t in (his, los, works, nbrs)]
+    tables = [jnp.asarray(t, jnp.int32)
+              for t in (his, los, works, nbr_coords)]
     unified = _unify_vma(*tables, *operands)
     tables, operands = unified[:4], unified[4:]
     like = operands[0]
@@ -586,24 +719,37 @@ def fused_ring_remote(
                          (bhi, qi, 0)),
             pl.BlockSpec((1, bq), lambda hop, bhi, qi, hi, lo, w, nb:
                          (bhi, qi)),
+            # HBM working buffers, returned-and-dropped: the circulated
+            # double buffer and the cross-hop (acc, m, l) spill.
+            hbm,
+            hbm,
+            hbm,
+            hbm,
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, 2, bh, n_local, dd), kv_dtype),
-            pltpu.VMEM((bh, n_local, d), jnp.float32),
-            pltpu.VMEM((bh, n_local, 1), jnp.float32),
-            pltpu.VMEM((bh, n_local, 1), jnp.float32),
+            pltpu.VMEM((2, 2, bk, dd), kv_dtype),
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.REGULAR,
         ],
     )
-    out_f, lse_f = pl.pallas_call(
+    out_f, lse_f, *_hbm_work = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
             _sds((bh, n_local, d), q.dtype, like),
             _sds((bh, n_local), jnp.float32, like),
+            _sds((2, 2, bh, n_local, dd), kv_dtype, like),
+            _sds((bh, n_local, d), jnp.float32, like),
+            _sds((bh, n_local, 1), jnp.float32, like),
+            _sds((bh, n_local, 1), jnp.float32, like),
         ],
-        compiler_params=pltpu.TPUCompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
             collective_id=collective_id,
         ),

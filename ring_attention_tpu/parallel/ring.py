@@ -666,12 +666,16 @@ def _ring_fwd_fused(
     """Fused-ring forward: the WHOLE hop schedule in one kernel launch
     (``ops/pallas_ring.py``), no per-hop dispatch, no ppermute.
 
-    Two tiers.  On TPU with remote-DMA support and an unmasked, unpacked
-    config, the remote tier circulates KV over ICI from inside the kernel
+    Two tiers.  On TPU with remote-DMA support, an unmasked, unpacked
+    config, and a healthy remote-tier probe
+    (``utils/resilience.fused_remote_available`` — a compile failure
+    there records a degradation instead of crashing the model path), the
+    remote tier circulates KV over ICI from inside the kernel
     (``fused_ring_remote`` — async double-buffered
     ``make_async_remote_copy`` per hop, overlap window = the whole hop's
     compute).  Everything else — interpret/CPU parity runs, masked or
-    packed sequences — takes the local tier: one all-gather of the KV
+    packed sequences, meshes whose axes cannot be introspected for MESH
+    device ids — takes the local tier: one all-gather of the KV
     span, then the same single launch walking the same hop tables
     (``fused_ring_local``).  Both visit hops in scan-path order with
     scan-path band offsets, so parity against ``_ring_fwd_pallas`` is
@@ -697,23 +701,28 @@ def _ring_fwd_fused(
     q8 = compute_dtype == "int8"
     wire8 = hop_compression is not None
 
+    from ..utils import resilience as _resilience  # lazy: avoid import cycle
+
     remote_ok = (
         not interpret
         and _pallas_ring.remote_supported()
         and kv_mask is None
         and segment_ids is None
         and q8 == wire8  # plain hops, or the fully-int8 wire+compute pair
+        and _resilience.fused_remote_available()  # probe-once, degrades
     )
     if remote_ok:
-        nbrs = jnp.stack(
-            [(rank - 1) % ring_size, (rank + 1) % ring_size]
-        ).astype(jnp.int32)
+        # Per-axis MESH coordinates of the ring neighbors — None on
+        # meshes we cannot introspect, which degrades to the local tier.
+        nbr_coords = _pallas_ring.neighbor_mesh_coords(axis_name, ring_size)
+    if remote_ok and nbr_coords is not None:
         payload = _quant.pack_kv(k, v, v_block=n_local) if q8 else None
         with jax.named_scope("ring/fused"):
             return _pallas_ring.fused_ring_remote(
-                q, k, v, his=his, los=los, works=works, nbrs=nbrs,
+                q, k, v, his=his, los=los, works=works,
+                nbr_coords=nbr_coords,
                 scale=scale, softclamp_value=softclamp_value,
-                block_q=blk_q, payload=payload,
+                block_q=blk_q, block_k=blk_k, payload=payload,
             )
 
     if wire8 and not q8:

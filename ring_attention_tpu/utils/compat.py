@@ -214,6 +214,29 @@ def host_device_put(tree, mesh=None):
     return jax.tree.map(place, tree)
 
 
+def bound_axis_names():
+    """Every mesh axis name bound at this point of the trace, in mesh
+    binding order — or None when the axis environment is unreadable.
+
+    The fused remote tier needs MESH-coordinate device ids (a coordinate
+    per mesh axis, not just the ring axis: a LOGICAL id built from the
+    ring coordinate alone addresses the wrong device on any multi-axis
+    mesh).  ``get_axis_env().axis_sizes`` is an insertion-ordered dict of
+    bound axes on every jax this repo supports; its private home moved
+    across releases, and a None here just means "no coordinate table",
+    which callers treat as "take the gathered-KV local tier instead" —
+    introspection failure must degrade, never crash."""
+    for mod in ("jax._src.core", "jax.core"):
+        try:
+            import importlib
+
+            env = importlib.import_module(mod).get_axis_env()
+            return tuple(env.axis_sizes.keys())
+        except Exception:  # noqa: BLE001 — degrade, never crash
+            continue
+    return None
+
+
 def axis_size(axis_name):
     """``lax.axis_size`` (new) or the bound axis frame's size (old).
 

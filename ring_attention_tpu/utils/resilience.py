@@ -666,6 +666,97 @@ def _probe_fused() -> None:
     jax.block_until_ready(out)
 
 
+# ICI tier of the fused ring: its OWN component, because its failure
+# mode is softer — fused_ring_local (gather + the same single launch)
+# still honors the "fused" contract, so a remote-tier Mosaic rejection
+# or VMEM overflow degrades one tier, not all the way to the scan ring.
+FUSED_REMOTE_COMPONENT = "fused_ring_remote"
+# fault name the injection harness arms to force the remote tier to fail
+FUSED_REMOTE_FAULT = "fused_remote_fail"
+
+_fused_remote_probe: bool | None = None
+
+
+def _probe_fused_remote() -> None:
+    """Compile-and-run a minimal ``fused_ring_remote`` launch — the tier
+    the TPU model path actually prefers, which the local-tier probe never
+    touches.  A one-device ring under ``shard_map`` exercises the whole
+    remote surface (ANY-space HBM buffers, barrier + grant semaphores,
+    MESH-coordinate device ids, the async-copy staging pipeline) without
+    needing a second chip; a Mosaic rejection here must become a recorded
+    degradation, not a hard failure on the first model step."""
+    get_injector().check(FUSED_REMOTE_FAULT)
+    import jax
+
+    if not remote_copy_supported():
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu lacks the remote-DMA surface "
+            "(make_async_remote_copy / semaphore primitives) — the fused "
+            "ring cannot circulate KV in-kernel on this jax version"
+        )
+    if jax.devices()[0].platform != "tpu":
+        raise RuntimeError(
+            f"backend {jax.devices()[0].platform!r} cannot execute "
+            "in-kernel remote DMA — remote tier degrades to the "
+            "gather-based fused_ring_local"
+        )
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+
+    from . import compat
+    from ..ops.pallas_ring import fused_ring_remote
+
+    n = 128
+
+    def core(q, k, v):
+        # Hardcoded (2, 1) self-coordinates, NOT neighbor_mesh_coords:
+        # this probe may run at trace time inside a model's shard_map,
+        # where the ambient axis env still holds the OUTER mesh axes —
+        # introspecting it here would leak outer tracers into this
+        # self-contained one-axis launch.  On a one-device ring both
+        # neighbors are rank 0 anyway.
+        coords = jnp.zeros((2, 1), jnp.int32)
+        return fused_ring_remote(
+            q, k, v,
+            his=jnp.zeros((1,), jnp.int32),
+            los=jnp.full((1,), -n, jnp.int32),
+            works=jnp.ones((1,), jnp.int32),
+            nbr_coords=coords,
+        )[0]
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("fused_probe",))
+    fn = compat.shard_map(
+        core, mesh=mesh,
+        in_specs=(PartitionSpec(),) * 3, out_specs=PartitionSpec(),
+        check_vma=False,
+    )
+    q = jnp.zeros((1, 1, n, 64), jnp.float32)
+    jax.block_until_ready(compat.jit(fn)(q, q, q))
+
+
+def fused_remote_available(*, refresh: bool = False) -> bool:
+    """True when the fused ring's in-kernel remote-DMA tier works here.
+
+    Probed once per process (cached, same lock discipline as
+    :func:`fused_ring_available`).  Every failure records a
+    :data:`FUSED_REMOTE_COMPONENT` degradation with a one-shot warning;
+    ``parallel/ring.py::_ring_fwd_fused`` consults this before choosing
+    the remote tier and falls back to ``fused_ring_local`` — still the
+    single-launch fused forward, just gather-fed."""
+    global _fused_remote_probe
+    with _pallas_probe_lock:
+        if _fused_remote_probe is not None and not refresh:
+            return _fused_remote_probe
+        try:
+            _probe_fused_remote()
+            _fused_remote_probe = True
+        except Exception as e:  # noqa: BLE001 — any failure means degrade
+            degradation.record(FUSED_REMOTE_COMPONENT, e)
+            _fused_remote_probe = False
+        return _fused_remote_probe
+
+
 def fused_ring_available(*, refresh: bool = False) -> bool:
     """True when the real fused-ring kernel path works on this backend.
 
@@ -723,13 +814,14 @@ def resolve_ring_impl(impl: str | None) -> str:
 def reset(*, probe: bool = True) -> None:
     """Test-harness hook: clear armed faults, degradation state, and
     (optionally) the cached Pallas/fused-ring probe results."""
-    global _pallas_probe, _fused_probe
+    global _pallas_probe, _fused_probe, _fused_remote_probe
     _INJECTOR.clear()
     degradation.reset()
     if probe:
         with _pallas_probe_lock:
             _pallas_probe = None
             _fused_probe = None
+            _fused_remote_probe = None
 
 
 # ----------------------------------------------------------------------

@@ -180,6 +180,75 @@ def test_fused_grads_match_scan(rng, mesh, devices, kw):
         np.testing.assert_array_equal(np.asarray(gf), np.asarray(gs))
 
 
+def test_neighbor_mesh_coords_multiaxis(mesh, devices):
+    """The remote tier's device-id table, pinned on a MULTI-axis mesh —
+    the exact shape where a ring-rank-only LOGICAL id addresses the wrong
+    replica group.  Every device's ``(2, naxes)`` MESH coordinates must
+    vary ONLY the ring axis and keep its own data coordinate, so each
+    replica group circulates KV strictly within itself."""
+    from ring_attention_tpu.ops.pallas_ring import neighbor_mesh_coords
+
+    ring = mesh.shape["seq"]
+
+    def core(x):
+        c = neighbor_mesh_coords("seq", ring)
+        assert c is not None  # trace-time: axes introspectable here
+        return c.reshape(1, 1, 2, c.shape[-1])
+
+    out = shard_map(
+        core, mesh=mesh,
+        in_specs=(P("data", "seq"),),
+        out_specs=P("data", "seq", None, None),
+        check_vma=False,
+    )(jnp.zeros((2, ring)))
+    coords = np.asarray(out)  # [di, si] -> that device's (2, naxes) table
+    assert coords.shape == (2, ring, 2, 2)
+    for di in range(2):
+        for si in range(ring):
+            np.testing.assert_array_equal(
+                coords[di, si, 0], [di, (si - 1) % ring])
+            np.testing.assert_array_equal(
+                coords[di, si, 1], [di, (si + 1) % ring])
+
+
+def test_fused_remote_probe_degrades_on_cpu(devices):
+    """Finding-4 pin: the REMOTE tier has its own probe + component.  On a
+    backend that cannot execute in-kernel remote DMA the probe records a
+    ``fused_ring_remote`` degradation (one-shot warning, queryable event)
+    instead of letting the model path hit a hard runtime failure — and
+    the fallback is ``fused_ring_local``, still the single-launch tier,
+    NOT the scan ring (``FUSED_COMPONENT`` stays healthy)."""
+    resilience.reset()
+    try:
+        with pytest.warns(UserWarning, match="fused_ring_remote degraded"):
+            assert resilience.fused_remote_available() is False
+        assert resilience.degradation.is_degraded(
+            resilience.FUSED_REMOTE_COMPONENT)
+        assert not resilience.degradation.is_degraded(
+            resilience.FUSED_COMPONENT)
+        # sticky: the probe is cached, no second warning
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert resilience.fused_remote_available() is False
+    finally:
+        resilience.reset()
+
+
+def test_fused_remote_fault_injection_degrades(devices):
+    """Armed ``FUSED_REMOTE_FAULT``: the remote-tier probe fails before
+    touching the kernel and records its own degradation — the
+    chaos-harness hook for the ICI tier specifically."""
+    resilience.reset()
+    try:
+        with resilience.inject(resilience.FUSED_REMOTE_FAULT):
+            with pytest.warns(UserWarning, match="fused_ring_remote"):
+                assert resilience.fused_remote_available() is False
+        assert resilience.degradation.is_degraded(
+            resilience.FUSED_REMOTE_COMPONENT)
+    finally:
+        resilience.reset()
+
+
 def test_fused_resolution_degrades_on_cpu(devices):
     """The resolution seam: on a backend without in-kernel remote copies
     (this CPU container), ``resolve_ring_impl`` records a ``fused_ring``
