@@ -1078,10 +1078,27 @@ def _decode_worker(impl: str, seq_len: int, extra: dict) -> None:
         return ys.astype(jnp.float32).sum()
 
     compile_s, secs = _timed(chained, (q, cache, mask), iters)
+
+    # per-call latency distribution: the chained scan above gives the
+    # amortized mean; this eager loop (one dispatch + block per token,
+    # the shape of a live decode server) feeds the mergeable fixed-bucket
+    # histogram that the perfgate latency family and generate.py share
+    from ring_attention_tpu.utils import tracing
+
+    single = jax.jit(lambda q, cache, mask: attend(q, *cache, mask))
+    single(q, cache, mask).block_until_ready()  # compile outside the loop
+    hist = tracing.LatencyHistogram()
+    for _ in range(30):
+        t0 = tracing.perf_counter()
+        single(q, cache, mask).block_until_ready()
+        hist.record(tracing.perf_counter() - t0)
     print(
         json.dumps(
             {
                 "decode_ms_per_token": round(secs * 1e3, 3),
+                "decode_ms_p50": round(hist.percentile_ms(50), 3),
+                "decode_ms_p95": round(hist.percentile_ms(95), 3),
+                "decode_ms_p99": round(hist.percentile_ms(99), 3),
                 "decode_kv_gbps": round(kv_bytes / secs / 1e9, 1),
                 "decode_seq_len": seq_len,
                 "decode_impl": impl,
@@ -1991,6 +2008,9 @@ def main() -> None:
             suffix = {"pallas": "", "pallas_q8": "_q8", "dense": "_dense"}[impl]
             for key in ("decode_ms_per_token", "decode_kv_gbps"):
                 result[key + suffix] = payload[key]
+            for key in ("decode_ms_p50", "decode_ms_p95", "decode_ms_p99"):
+                if key in payload:
+                    result[key + suffix] = payload[key]
             if impl == "pallas":
                 result["decode_seq_len"] = payload["decode_seq_len"]
                 result["decode_kv_heads"] = payload["decode_kv_heads"]

@@ -46,6 +46,10 @@ def main() -> None:
                     help="telemetry: append decode-throughput JSONL rows "
                          "(tok/s, ms/token, prefill length) for "
                          "tools/trace_report.py (docs/observability.md)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="span tracing: one span per decoded token plus "
+                         "prefill, merged with tools/cluster_timeline.py "
+                         "(docs/observability.md §6)")
     args = ap.parse_args()
 
     if args.temperature <= 0.0 and (args.top_k is not None
@@ -81,6 +85,12 @@ def main() -> None:
     warnings.filterwarnings(
         "ignore", message="Some donated buffers were not usable"
     )
+
+    from ring_attention_tpu.utils import tracing
+
+    if args.trace_dir:
+        tracing.configure(args.trace_dir, process=jax.process_index())
+    tracer = tracing.get_tracer()
 
     n_dev = len(jax.devices())
     mesh = create_mesh(ring_size=n_dev) if n_dev > 1 else None
@@ -121,11 +131,14 @@ def main() -> None:
         log_decode(tokens=len(toks), seconds=round(dt, 4),
                    tokens_per_sec=round(len(toks) / dt, 2),
                    sampled=True, compile_included=True)
+        if args.trace_dir:
+            tracing.shutdown()
         return
 
     # prefill once, then jit one decode step and stream
-    cache = model.apply(params, 1, args.max_len, method=RingTransformer.init_cache)
-    logits, cache = model.apply(params, prompt, cache, method=RingTransformer.prefill)
+    with tracer.span("decode/prefill", prompt_len=args.prompt_len):
+        cache = model.apply(params, 1, args.max_len, method=RingTransformer.init_cache)
+        logits, cache = model.apply(params, prompt, cache, method=RingTransformer.prefill)
 
     # donate the KV cache: each step's updated cache reuses the previous
     # step's buffers instead of double-allocating the whole cache
@@ -137,20 +150,39 @@ def main() -> None:
     )
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     toks = [int(tok[0])]
+    # per-token latency distribution: each iteration is a traced span
+    # AND a histogram sample (the `int(tok[0])` conversion syncs on the
+    # device, so the span covers the real token latency, first-token
+    # compile included in sample 0)
+    hist = tracing.LatencyHistogram()
     t0 = time.perf_counter()
     for i in range(args.steps - 1):
-        logits, cache = step(params, tok, cache, jnp.int32(args.prompt_len + i))
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        toks.append(int(tok[0]))
+        ts = time.perf_counter()
+        with tracer.span("decode/token", index=i):
+            logits, cache = step(params, tok, cache,
+                                 jnp.int32(args.prompt_len + i))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            toks.append(int(tok[0]))
+        hist.record(time.perf_counter() - ts)
     dt = time.perf_counter() - t0
     print(f"devices={n_dev}  generated {len(toks)} tokens "
           f"({(len(toks) - 1) / dt:.1f} tok/s after prefill)")
     print("tokens:", toks)
+    if hist.n:
+        print(f"token latency: p50 {hist.percentile_ms(50):.2f} ms  "
+              f"p95 {hist.percentile_ms(95):.2f} ms  "
+              f"p99 {hist.percentile_ms(99):.2f} ms")
     if len(toks) > 1:
         log_decode(tokens=len(toks), seconds=round(dt, 4),
                    tokens_per_sec=round((len(toks) - 1) / dt, 2),
                    ms_per_token=round(dt * 1e3 / (len(toks) - 1), 3),
+                   decode_ms_p50=round(hist.percentile_ms(50), 3),
+                   decode_ms_p95=round(hist.percentile_ms(95), 3),
+                   decode_ms_p99=round(hist.percentile_ms(99), 3),
+                   latency_hist=hist.to_dict(),
                    sampled=False, compile_included=False)
+    if args.trace_dir:
+        tracing.shutdown()
 
 
 if __name__ == "__main__":

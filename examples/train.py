@@ -171,6 +171,12 @@ def main() -> None:
     ap.add_argument("--flight-dir", default=None,
                     help="flight-dump directory (default: "
                          "METRICS_DIR/flight)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="span tracing: write one per-process span JSONL "
+                         "file (step phases, checkpoint save/commit, "
+                         "barrier waits, watchdog beats) — merge across "
+                         "processes with tools/cluster_timeline.py "
+                         "(docs/observability.md §6)")
     args = ap.parse_args()
     if args.log_every < 1:
         ap.error("--log-every must be >= 1")
@@ -228,6 +234,14 @@ def main() -> None:
 
     n_dev = len(jax.devices())
     n_proc = jax.process_count()
+
+    # span tracing (docs/observability.md §6): each process appends to
+    # its own spans_pNNNNN.jsonl; tools/cluster_timeline.py merges them
+    # into one clock-corrected cluster timeline
+    if args.trace_dir:
+        from ring_attention_tpu.utils import tracing
+
+        tracing.configure(args.trace_dir, process=jax.process_index())
     if args.dcn_data_size is None and n_proc > 1:
         # multihost default: one dcn group per process, rings inside
         args.dcn_data_size = n_proc
@@ -550,6 +564,10 @@ def main() -> None:
             elastic_mgr.close()  # flush any in-flight async save
         if guard is not None:
             guard.uninstall()
+        if args.trace_dir:
+            from ring_attention_tpu.utils import tracing
+
+            tracing.shutdown()
     if logger is not None:
         logger.close()
         print(f"metrics: {logger.path} (render with tools/trace_report.py)")
@@ -561,7 +579,7 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
                 metrics, stats, batch, collect, guarded, mgr, logger,
                 start, mfu_flops, comms, peak, guard=None, n_proc=1,
                 dog=None):
-    from ring_attention_tpu.utils import achieved_mfu
+    from ring_attention_tpu.utils import achieved_mfu, tracing
     from ring_attention_tpu.utils.train import StepStats
 
     def make_ckpt():
@@ -580,54 +598,62 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
             return guard.should_stop_cluster(step=step)
         return guard.should_stop()
 
+    tracer = tracing.get_tracer()
     for step in range(start, args.steps):
-        if collect:
-            params, opt_state, metrics, loss = train_step(
-                params, opt_state, metrics, *batch
-            )
-            # checkpointed StepStats stays structure-compatible with
-            # uninstrumented runs; it mirrors the metrics counters
-            stats = StepStats(step_ok=metrics.step_ok,
-                              skipped=metrics.skipped)
-            if recorder is not None:
-                dump = recorder.observe_step(step, metrics)
-                if dump:
-                    print(f"flight recorder: nonfinite step {step} -> "
-                          f"{dump}")
-        elif guarded:
-            params, opt_state, stats, loss = train_step(
-                params, opt_state, stats, *batch
-            )
-        else:
-            params, opt_state, loss = train_step(params, opt_state, *batch)
-        timer.step(loss)
+        # the step-phase span measures host-side dispatch + the loss
+        # sync inside timer.step; the compiled program itself is pinned
+        # untraced (tests/test_tracing.py HLO pin)
+        with tracer.span("train/step", step=step):
+            if collect:
+                params, opt_state, metrics, loss = train_step(
+                    params, opt_state, metrics, *batch
+                )
+                # checkpointed StepStats stays structure-compatible with
+                # uninstrumented runs; it mirrors the metrics counters
+                stats = StepStats(step_ok=metrics.step_ok,
+                                  skipped=metrics.skipped)
+                if recorder is not None:
+                    dump = recorder.observe_step(step, metrics)
+                    if dump:
+                        print(f"flight recorder: nonfinite step {step} "
+                              f"-> {dump}")
+            elif guarded:
+                params, opt_state, stats, loss = train_step(
+                    params, opt_state, stats, *batch
+                )
+            else:
+                params, opt_state, loss = train_step(
+                    params, opt_state, *batch
+                )
+            timer.step(loss)
         if dog is not None:
             dog.beat(step)
         if step % args.log_every == 0 or step == args.steps - 1:
-            skipped = int(stats.skipped) if (guarded or collect) else 0
-            print(
-                f"step {step:4d}  loss {float(loss):.4f}  "
-                f"{timer.tokens_per_sec:,.0f} tok/s"
-                + (f"  [skipped {skipped}]" if skipped else "")
-            )
-            if logger is not None:
-                sps = timer.steps_per_sec
-                logger.log(
-                    step,
-                    loss=float(loss),
-                    grad_norm=float(metrics.grad_norm),
-                    step_ok=bool(metrics.step_ok),
-                    skipped=int(metrics.skipped),
-                    nonfinite=int(metrics.nonfinite),
-                    tokens_per_sec=round(timer.tokens_per_sec, 1),
-                    steps_per_sec=round(sps, 4),
-                    step_ms_p50=round(timer.step_ms_p50, 2),
-                    step_ms_p95=round(timer.step_ms_p95, 2),
-                    mfu=round(
-                        achieved_mfu(mfu_flops, 1.0 / sps, peak), 6
-                    ) if sps > 0 else 0.0,
-                    **comms,
+            with tracer.span("train/log", step=step):
+                skipped = int(stats.skipped) if (guarded or collect) else 0
+                print(
+                    f"step {step:4d}  loss {float(loss):.4f}  "
+                    f"{timer.tokens_per_sec:,.0f} tok/s"
+                    + (f"  [skipped {skipped}]" if skipped else "")
                 )
+                if logger is not None:
+                    sps = timer.steps_per_sec
+                    logger.log(
+                        step,
+                        loss=float(loss),
+                        grad_norm=float(metrics.grad_norm),
+                        step_ok=bool(metrics.step_ok),
+                        skipped=int(metrics.skipped),
+                        nonfinite=int(metrics.nonfinite),
+                        tokens_per_sec=round(timer.tokens_per_sec, 1),
+                        steps_per_sec=round(sps, 4),
+                        step_ms_p50=round(timer.step_ms_p50, 2),
+                        step_ms_p95=round(timer.step_ms_p95, 2),
+                        mfu=round(
+                            achieved_mfu(mfu_flops, 1.0 / sps, peak), 6
+                        ) if sps > 0 else 0.0,
+                        **comms,
+                    )
         if drain_requested(step):
             # preemption drain: this step FINISHED (we're at the step
             # boundary); save synchronously, dump the incident with its
@@ -643,7 +669,8 @@ def _train_loop(args, recorder, timer, train_step, params, opt_state,
         if mgr is not None and (
             step % args.ckpt_every == 0 or step == args.steps - 1
         ):
-            mgr.save(step, make_ckpt())
+            with tracer.span("train/ckpt", step=step):
+                mgr.save(step, make_ckpt())
 
 
 if __name__ == "__main__":

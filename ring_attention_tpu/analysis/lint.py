@@ -95,6 +95,17 @@ past PR, with the shim/convention that prevents it:
          counted contract; new in-kernel communication goes through the
          fused module's seam, anything else carries a reasoned allow.
 
+  RA014  raw host clocks (``time.time`` / ``time.monotonic`` /
+         ``time.perf_counter`` / ...) in the observability-instrumented
+         subpackages (``elastic/``, ``utils/``) outside the timestamp
+         seam (``utils/tracing.py``).  Every emitted timestamp must
+         route through the seam's ``monotonic_wall()``/``monotonic()``/
+         ``perf_counter()`` helpers so the cluster-timeline merger can
+         correct clocks consistently; a module stamping rows with its
+         own ``time.*`` call produces offsets the merger never sees.
+         Deadline arithmetic and filesystem-mtime comparisons carry a
+         reasoned allow.
+
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
 itself a violation.  See docs/static_analysis.md.
@@ -186,6 +197,15 @@ FUSED_KERNEL_MODULE = "ops/pallas_ring.py"
 QUANT_SEAM_MODULE = "ops/quant.py"
 INT8_FULL_SCALE = 127  # ra: allow(RA012 the rule's own definition of the constant)
 
+# RA014: subpackages whose host-side timestamps must route through the
+# tracing seam (the merger's clock-offset correction needs ONE source of
+# wall/monotonic pairs), and the seam module itself.
+TIMESTAMP_SCOPES = (
+    "ring_attention_tpu/elastic/",
+    "ring_attention_tpu/utils/",
+)
+TIMESTAMP_SEAM_MODULE = "utils/tracing.py"
+
 _ALLOW_RE = re.compile(r"#\s*ra:\s*allow\(\s*(RA\d{3})\b([^)]*)\)")
 
 
@@ -246,6 +266,9 @@ class _Linter(ast.NodeVisitor):
             or f"/{p}/" in rel.replace("\\", "/")
             for p in TRACED_SUBPACKAGES
         )
+        self.in_time_scope = any(
+            m in rel.replace("\\", "/") for m in TIMESTAMP_SCOPES
+        ) and not rel.replace("\\", "/").endswith(TIMESTAMP_SEAM_MODULE)
 
     def flag(self, node: ast.AST, rule: str, message: str) -> None:
         lineno = getattr(node, "lineno", 1)
@@ -363,6 +386,18 @@ class _Linter(ast.NodeVisitor):
                           "traced value this raises or silently constant-"
                           "folds at trace time; use jnp, or allow with a "
                           "reason for provably static trace-time data")
+
+        if self.in_time_scope and isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            if chain.startswith("time.") and name in HOST_TIME_ATTRS:
+                self.flag(node, "RA014",
+                          f"raw host clock {chain}() outside the "
+                          "utils/tracing.py timestamp seam — emitted "
+                          "timestamps must come from the seam's helpers "
+                          "(monotonic_wall/monotonic/perf_counter) so "
+                          "the cluster-timeline merger's clock-offset "
+                          "correction covers them; deadline arithmetic "
+                          "or mtime comparisons carry a reasoned allow")
 
         if (name == "print" and isinstance(func, ast.Name)
                 and not self.rel.endswith("__main__.py")):  # __main__ IS a CLI
@@ -494,7 +529,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA013)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA014)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

@@ -68,6 +68,12 @@ HARDWARE_SERIES = {
     "fused262k_tflops": ("fused262k", +1),
     "packed262k_tokens_per_sec": ("packed262k", +1),
     "decode_ms_per_token": ("decode_ms_per_token", -1),
+    # per-call decode latency distribution (bench phase 6's eager loop
+    # through utils/tracing.LatencyHistogram): the tail regresses before
+    # the chained mean does — a slow outlier every 20 tokens moves p95
+    # 1:1 but the amortized ms/token by only 5%
+    "decode_ms_p50": ("decode_ms_p50", -1),
+    "decode_ms_p95": ("decode_ms_p95", -1),
 }
 
 # The analytic comms reference table: fixed north-star-shaped configs
@@ -323,6 +329,41 @@ def comms_reference_signals() -> dict[str, dict[str, int]]:
     return out
 
 
+def latency_reference_signals() -> dict[str, Any]:
+    """The latency-histogram codec's fixed point — pure arithmetic, no
+    jax, no devices, no clock.
+
+    A deterministic LCG sample pushed through
+    ``utils/tracing.LatencyHistogram`` pins the bucket geometry (count,
+    scale tag, edge checksum) and the integer percentile read-off as
+    EXACT values.  Any change to the bucket edges or the rank rule
+    silently re-scales every decode-latency number the hardware gate
+    compares across rounds — this family makes that a one-line gate
+    failure instead, and the baseline must be consciously re-recorded
+    together with the hardware history it invalidates."""
+    from ring_attention_tpu.utils.tracing import (
+        BUCKET_BOUNDS_NS,
+        HIST_BUCKETS,
+        HIST_SCALE,
+        LatencyHistogram,
+    )
+
+    hist = LatencyHistogram()
+    x = 1
+    for _ in range(1000):
+        x = (x * 48271) % 2147483647  # minstd LCG: portable, seedless
+        hist.record_ns(1_000 + x % 50_000_000)  # 1 us .. 50 ms spread
+    return {
+        "hist_scale": HIST_SCALE,
+        "hist_buckets": int(HIST_BUCKETS),
+        "edge_checksum": int(sum(BUCKET_BOUNDS_NS)),
+        "n": int(hist.n),
+        "p50_ns": int(hist.percentile_ns(50)),
+        "p95_ns": int(hist.percentile_ns(95)),
+        "p99_ns": int(hist.percentile_ns(99)),
+    }
+
+
 def compiled_reference_signals() -> dict[str, Any]:
     """Compiler-facing signals of the reference train step: counted
     FLOPs/bytes (``compiled_cost``), peak scratch (``compiled_memory``),
@@ -406,6 +447,7 @@ def collect_current(
         "gate_schema": GATE_SCHEMA_VERSION,
         "jax": jax.__version__,
         "comms": comms_reference_signals(),
+        "latency": latency_reference_signals(),
     }
     if coverage:
         from .coverage import coverage_fingerprint
@@ -461,7 +503,8 @@ def check_baseline(
     base_signals = baseline.get("signals", baseline)
 
     # exact families -----------------------------------------------------
-    for family in ("fingerprint", "comms", "coverage", "multihost"):
+    for family in ("fingerprint", "comms", "coverage", "multihost",
+                   "latency"):
         base = base_signals.get(family)
         cur = current.get(family)
         if base is None:

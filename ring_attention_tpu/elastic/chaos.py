@@ -43,6 +43,7 @@ import sys
 import time
 from typing import Any, Iterable, Mapping, Sequence
 
+from ..utils import tracing
 from ..utils.resilience import get_injector
 
 # exit code a chaos_point death uses: distinguishable from a crash (1),
@@ -85,6 +86,19 @@ def chaos_point(name: str, *, step: int | None = None) -> None:
                 return
         except (TypeError, ValueError):
             pass
+    # the kill instant + flushed open spans are O_APPEND span-file
+    # writes: durable the moment they return, so the merged cluster
+    # timeline names the victim and the fault even though os._exit
+    # skips every normal shutdown path
+    try:
+        tracer = tracing.get_tracer()
+        tracer.instant(
+            "chaos/kill", fault=name, exit_code=CHAOS_EXIT_CODE,
+            **({"step": step} if step is not None else {}),
+        )
+        tracer.flush_open("chaos_kill")
+    except Exception:  # noqa: BLE001 — diagnostics must not save the victim
+        pass
     # one line of evidence for the parent's log, then nothing runs after
     sys.stderr.write(f"chaos: dying at {name}"
                      + (f" (step {step})" if step is not None else "") + "\n")
@@ -116,6 +130,10 @@ def arm_from_env(environ: Mapping[str, str] | None = None) -> list[str]:
                     value = raw
         inj.arm(name, value)
         armed.append(name)
+    if armed:
+        # the fault window opens HERE: armed -> chaos/kill is the span
+        # of exposure the incident reconstruction annotates
+        tracing.get_tracer().instant("chaos/armed", faults=",".join(armed))
     return armed
 
 
@@ -327,10 +345,10 @@ class ChaosWorker:
                 text=True, env=env, cwd=self.cwd,
             ))
         results: list[subprocess.CompletedProcess | None] = [None] * processes
-        deadline = time.monotonic() + self.timeout
+        deadline = time.monotonic() + self.timeout  # ra: allow(RA014 deadline arithmetic over worker reaping, not an emitted timestamp)
         try:
             for pid, p in enumerate(procs):
-                budget = max(deadline - time.monotonic(), 0.01)
+                budget = max(deadline - time.monotonic(), 0.01)  # ra: allow(RA014 deadline arithmetic over worker reaping, not an emitted timestamp)
                 try:
                     out, _ = p.communicate(timeout=budget)
                 except subprocess.TimeoutExpired:

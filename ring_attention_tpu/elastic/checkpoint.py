@@ -88,6 +88,7 @@ from ..utils.checkpoint import (
     _fsync_dir,
     _sha256,
 )
+from ..utils import tracing
 from ..utils.resilience import DirectoryLock, pid_alive
 from . import chaos
 
@@ -211,22 +212,31 @@ def cross_process_barrier(name: str, *, timeout_s: float = 600.0) -> None:
     turns into :class:`BarrierTimeout` after ``timeout_s``."""
     if jax.process_count() <= 1:
         return
-    client = _distributed_client()
-    if client is None:
-        # no coordinator client exposed on this build: the device-level
-        # barrier still rendezvouses (main thread only — documented)
-        from jax.experimental import multihost_utils
+    tracer = tracing.get_tracer()
+    # the wait is a span (its duration IS the straggler signal: the
+    # survivor of a dead peer shows one long barrier/wait ending in
+    # BarrierTimeout); the EXIT is a rendezvous stamp — every process
+    # leaves the same barrier at nearly the same true instant, which is
+    # what the merger's clock-offset correction aligns on
+    with tracer.span("barrier/wait", barrier=name, timeout_s=timeout_s):
+        client = _distributed_client()
+        if client is None:
+            # no coordinator client exposed on this build: the
+            # device-level barrier still rendezvouses (main thread only
+            # — documented)
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
-        return
-    try:
-        client.wait_at_barrier(name, int(timeout_s * 1000))
-    except Exception as e:  # noqa: BLE001 — jaxlib raises backend types
-        raise BarrierTimeout(
-            f"cross-process barrier {name!r} expired after {timeout_s:.0f}s "
-            f"— a peer process died or wedged mid-checkpoint "
-            f"({type(e).__name__}: {e})"
-        ) from e
+            multihost_utils.sync_global_devices(name)
+        else:
+            try:
+                client.wait_at_barrier(name, int(timeout_s * 1000))
+            except Exception as e:  # noqa: BLE001 — backend types
+                raise BarrierTimeout(
+                    f"cross-process barrier {name!r} expired after "
+                    f"{timeout_s:.0f}s — a peer process died or wedged "
+                    f"mid-checkpoint ({type(e).__name__}: {e})"
+                ) from e
+    tracer.rendezvous(name)
 
 
 class ElasticCheckpointManager:
@@ -358,7 +368,7 @@ class ElasticCheckpointManager:
                     # minimum age (it might be a live writer from a
                     # manager version with another naming scheme)
                     try:
-                        age = time.time() - os.path.getmtime(path)
+                        age = time.time() - os.path.getmtime(path)  # ra: allow(RA014 mtime age against the filesystem wall clock, not an emitted timestamp)
                     except OSError:
                         continue
                     if age >= 60.0:
@@ -512,25 +522,34 @@ class ElasticCheckpointManager:
                 "shards": entries,
             })
         files = {}
-        for fname in sorted(groups):
-            path = os.path.join(stage, fname)
-            with open(path, "wb") as f:
-                np.savez(f, **groups[fname])
-                f.flush()
-                os.fsync(f.fileno())
-            files[fname] = {
-                "sha256": _sha256(path),
-                "bytes": os.path.getsize(path),
-            }
-            # chaos: die with SOME shard files durable and the
-            # manifest absent — the torn-write window the commit
-            # protocol must make unobservable
-            chaos.chaos_point(chaos.KILL_MID_SHARD)
+        tracer = tracing.get_tracer()
+        with tracer.span("ckpt/stage", files=len(groups)):
+            for fname in sorted(groups):
+                path = os.path.join(stage, fname)
+                with open(path, "wb") as f:
+                    np.savez(f, **groups[fname])
+                    f.flush()
+                    os.fsync(f.fileno())
+                with tracer.span("ckpt/hash", file=fname):
+                    digest = _sha256(path)
+                files[fname] = {
+                    "sha256": digest,
+                    "bytes": os.path.getsize(path),
+                }
+                # chaos: die with SOME shard files durable and the
+                # manifest absent — the torn-write window the commit
+                # protocol must make unobservable
+                chaos.chaos_point(chaos.KILL_MID_SHARD)
         return leaf_table, files
 
     def _commit(self, step: int, stage: str, final: str,
                 leaf_table: list, files: dict, snap: dict) -> None:
         """Write the manifest LAST, fsync, then the one atomic rename."""
+        with tracing.get_tracer().span("ckpt/commit", step=int(step)):
+            self._commit_impl(step, stage, final, leaf_table, files, snap)
+
+    def _commit_impl(self, step: int, stage: str, final: str,
+                     leaf_table: list, files: dict, snap: dict) -> None:
         manifest = {
             "format": MANIFEST_FORMAT,
             "version": MANIFEST_VERSION,
@@ -567,6 +586,12 @@ class ElasticCheckpointManager:
             shutil.rmtree(backup, ignore_errors=True)
 
     def _write(self, step: int, snap: dict) -> str:
+        with tracing.get_tracer().span(
+            "ckpt/save", step=int(step), nproc=self._nproc
+        ):
+            return self._write_impl(step, snap)
+
+    def _write_impl(self, step: int, snap: dict) -> str:
         if self._nproc > 1:
             return self._write_multiprocess(step, snap)
         with self._dirlock.locked(timeout=self.lock_timeout):
@@ -701,7 +726,8 @@ class ElasticCheckpointManager:
         ``block=True`` (or the manager was built ``async_save=False``).
         """
         self.wait()
-        snap = self._snapshot(state)
+        with tracing.get_tracer().span("ckpt/snapshot", step=int(step)):
+            snap = self._snapshot(state)
         # barrier-id generation: every process calls save in lockstep, so
         # a per-manager counter names the same rendezvous on all of them
         self._sync += 1
@@ -885,6 +911,15 @@ class ElasticCheckpointManager:
         the placement for template leaves without an explicit
         ``NamedSharding`` (restored replicated over it).
         """
+        with tracing.get_tracer().span(
+            "ckpt/restore", nproc=self._nproc,
+            **({"step": int(step)} if step is not None else {}),
+        ):
+            return self._restore_traced(template, mesh=mesh, step=step)
+
+    def _restore_traced(
+        self, template: Any, *, mesh=None, step: int | None = None
+    ) -> tuple[Any, int] | None:
         from ..utils.resilience import LockTimeout
 
         if self._nproc > 1:

@@ -37,6 +37,7 @@ import signal
 import threading
 from typing import Any, Callable
 
+from ..utils import tracing
 from ..utils.resilience import get_injector
 
 # fault-injector name that simulates a preemption signal (chaos harness)
@@ -206,13 +207,18 @@ class PreemptionGuard:
         if self.drained:
             return
         self.drained = True
-        try:
-            if save_fn is not None:
-                save_fn()
-        finally:
-            if recorder is not None:
-                recorder.dump(
-                    "preemption",
-                    signal=self.signal_name or "unknown",
-                    **({"step": step} if step is not None else {}),
-                )
+        with tracing.get_tracer().span(
+            "preempt/drain",
+            signal=self.signal_name or "unknown",
+            **({"step": step} if step is not None else {}),
+        ):
+            try:
+                if save_fn is not None:
+                    save_fn()
+            finally:
+                if recorder is not None:
+                    recorder.dump(
+                        "preemption",
+                        signal=self.signal_name or "unknown",
+                        **({"step": step} if step is not None else {}),
+                    )

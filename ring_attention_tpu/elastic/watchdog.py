@@ -23,8 +23,9 @@ from __future__ import annotations
 import os
 import sys
 import threading
-import time
 from typing import Callable
+
+from ..utils import tracing
 
 # distinguishable from success (0), a crash (1), a signal death
 # (negative), and a chaos_point death (113)
@@ -71,7 +72,7 @@ class Watchdog:
         self.poll_s = poll_s if poll_s is not None else min(
             max(deadline_s / 10.0, 0.05), 5.0
         )
-        self._last = time.monotonic() if arm_immediately else None
+        self._last = tracing.monotonic() if arm_immediately else None
         self._step: int | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -80,9 +81,15 @@ class Watchdog:
     # -- the loop-side API --------------------------------------------
 
     def beat(self, step: int | None = None) -> None:
-        """The training loop reached a step boundary: reset the clock."""
+        """The training loop reached a step boundary: reset the clock.
+        With a tracer configured, each beat is an instant row — the
+        cluster timeline's per-process liveness track (the gap before a
+        wedge is visible straggler evidence)."""
         self._step = step
-        self._last = time.monotonic()
+        self._last = tracing.monotonic()
+        tracing.get_tracer().instant(
+            "watchdog/beat", **({"step": step} if step is not None else {})
+        )
 
     def start(self) -> "Watchdog":
         if self._thread is not None:
@@ -111,7 +118,7 @@ class Watchdog:
         while not self._stop.wait(self.poll_s):
             if self._last is None:
                 continue  # not armed until the first beat
-            stale = time.monotonic() - self._last
+            stale = tracing.monotonic() - self._last
             if stale < self.deadline_s:
                 continue
             self.fired = True
@@ -125,6 +132,22 @@ class Watchdog:
                 f"is wedged (dead peer / hung device); aborting so the "
                 f"supervisor can restart from the last checkpoint"
             )
+            # the abort instant + flushed open spans are O_APPEND writes
+            # — durable before os._exit, so the merged cluster timeline
+            # names this process and what it was stuck inside even
+            # though no normal shutdown will ever run here
+            try:
+                tracer = tracing.get_tracer()
+                tracer.instant(
+                    "watchdog/abort", stale_s=round(stale, 1),
+                    deadline_s=self.deadline_s,
+                    exit_code=WATCHDOG_EXIT_CODE,
+                    **({"step": self._step}
+                       if self._step is not None else {}),
+                )
+                tracer.flush_open("watchdog_abort")
+            except Exception:  # noqa: BLE001 — diagnostics must not
+                pass           # block the abort itself
             if self.recorder is not None:
                 try:
                     self.recorder.dump(

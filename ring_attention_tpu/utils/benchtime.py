@@ -23,6 +23,11 @@ import os
 import time
 from typing import Callable, Sequence
 
+try:
+    from .tracing import perf_counter as _perf_counter
+except ImportError:  # standalone file-path load (bench parent)
+    _perf_counter = time.perf_counter
+
 __all__ = ["enable_compile_cache", "fetch_rtt", "timed_chained"]
 
 
@@ -61,9 +66,9 @@ def fetch_rtt(samples: int = 3) -> float:
     _ = float(f(jnp.float32(0)))  # compile outside the timed region
     best = float("inf")
     for i in range(samples):
-        t0 = time.perf_counter()
+        t0 = _perf_counter()
         _ = float(f(jnp.float32(i)))
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, _perf_counter() - t0)
     return best
 
 
@@ -82,13 +87,13 @@ def timed_chained(
     too.  Raises ``RuntimeError`` if the measured time is not above the
     fetch round trip — a nonsense number is worse than no number.
     """
-    t0 = time.perf_counter()
+    t0 = _perf_counter()
     _ = float(chained_fn(*args))
-    first_total = time.perf_counter() - t0
+    first_total = _perf_counter() - t0
     rtt = fetch_rtt()
-    t0 = time.perf_counter()
+    t0 = _perf_counter()
     value = float(chained_fn(*args))
-    total = time.perf_counter() - t0
+    total = _perf_counter() - t0
     if total <= rtt:
         raise RuntimeError(
             f"measurement ({total * 1e3:.1f} ms) not above fetch RTT "

@@ -241,7 +241,7 @@ class CheckpointManager:
                     continue  # live concurrent writer: not ours to sweep
                 if writer is None:
                     try:
-                        age = time.time() - os.stat(path).st_mtime
+                        age = time.time() - os.stat(path).st_mtime  # ra: allow(RA014 mtime age against the filesystem wall clock, not an emitted timestamp)
                     except OSError:
                         continue
                     if age < self._TMP_MIN_AGE_S:

@@ -36,6 +36,11 @@ import warnings
 from dataclasses import dataclass, field
 from typing import Any, Iterator, NamedTuple
 
+try:
+    from .tracing import perf_counter as _perf_counter
+except ImportError:  # standalone file-path load (tools/trace_report.py)
+    _perf_counter = time.perf_counter
+
 
 @contextlib.contextmanager
 def trace(logdir: str):
@@ -113,7 +118,7 @@ class StepTimer:
                     "throughput",
                     stacklevel=2,
                 )
-        now = time.perf_counter()
+        now = _perf_counter()
         if self._times and now <= self._times[-1]:
             self.clock_anomalies += 1
             self._times.clear()

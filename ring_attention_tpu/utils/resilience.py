@@ -38,6 +38,17 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Sequence
 
+try:
+    from . import tracing as _tracing
+except ImportError:  # standalone file-path load (tools, bench parent)
+    _tracing = None
+
+
+def _tracer():
+    """The active span tracer, or None on a standalone file-path load
+    where the relative import (and hence span emission) is unavailable."""
+    return _tracing.get_tracer() if _tracing is not None else None
+
 
 # ----------------------------------------------------------------------
 # Fault injection
@@ -351,7 +362,7 @@ class DirectoryLock:
             # stale_age is debris, not a writer
             holder = None
         try:
-            age = time.time() - os.stat(self.path).st_mtime
+            age = time.time() - os.stat(self.path).st_mtime  # ra: allow(RA014 mtime age against the filesystem wall clock, not an emitted timestamp)
         except OSError:
             return  # lock vanished between checks: next acquire retries
         if (holder is not None and pid_alive(holder)) or age < self.stale_age:
@@ -368,10 +379,23 @@ class DirectoryLock:
         0 = one nonblocking attempt).  Returns True when held; raises
         :class:`LockTimeout` when the budget runs out.  NOT re-entrant:
         a thread that already holds the lock must not re-acquire it."""
+        tracer = _tracer()
+        if tracer is None or not tracer.enabled:
+            return self._acquire(timeout)
+        # the lock-wait span IS the straggler signal: a process stuck
+        # behind a dead holder shows up on the cluster timeline as one
+        # long lock/acquire span (errored with LockTimeout if it loses)
+        with tracer.span("lock/acquire", path=self.path,
+                         timeout=timeout) as sp:
+            got = self._acquire(timeout)
+            sp.set(held=got)
+            return got
+
+    def _acquire(self, timeout: float | None) -> bool:
         # ONE deadline covers both waits: the in-process tlock and the
         # filesystem loop share the budget (counting it twice would let
         # acquire(600) block for 20 minutes)
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout  # ra: allow(RA014 deadline arithmetic; the acquire() span records the wait)
         # within-process contention first: a sibling thread holding the
         # filesystem lock is contention, not ownership
         if timeout == 0:
@@ -391,7 +415,7 @@ class DirectoryLock:
                 if self._try_acquire():
                     return True
                 self._takeover_if_stale()
-                if deadline is not None and time.monotonic() >= deadline:
+                if deadline is not None and time.monotonic() >= deadline:  # ra: allow(RA014 deadline arithmetic; the acquire() span records the wait)
                     # nonblocking mode still deserves one retry AFTER the
                     # takeover: a stale lock (dead holder) must not make
                     # a timeout=0 acquire fail when the dir is free now

@@ -47,6 +47,22 @@ import threading
 import time
 from typing import Any, Iterator, NamedTuple
 
+try:
+    from .tracing import monotonic_wall as _monotonic_wall
+except ImportError:  # standalone file-path load (tools, bench parent)
+    def _monotonic_wall() -> tuple[float, float]:
+        return time.monotonic(), time.time()  # ra: allow(RA014 the standalone-load fallback IS the seam's mirror)
+
+
+def _active_tracer():
+    """The process-global span tracer, or None on a standalone file-path
+    load (tools) where the relative import is unavailable."""
+    try:
+        from . import tracing
+    except ImportError:
+        return None
+    return tracing.get_tracer()
+
 # JSONL row schema version.  Bump when a field is renamed or its meaning
 # changes; adding fields is backward compatible and needs no bump.
 # v1: schema, step, time, plus free-form metric scalars (see
@@ -207,8 +223,16 @@ class Telemetry:
     # -- host-side events -------------------------------------------------
 
     def event(self, kind: str, **fields: Any) -> None:
+        # monotonic+wall pair (shared helper with tracing.py): wall alone
+        # cannot order events across processes — an NTP step or host skew
+        # reorders a merged timeline; the mono stamp pins local order and
+        # the merger's clock-offset correction handles the rest
+        mono, wall = _monotonic_wall()
         with self._lock:
-            self._events.append({"event": kind, "time": time.time(), **fields})
+            self._events.append(
+                {"event": kind, "time": wall, "mono": round(mono, 6),
+                 **fields}
+            )
 
     def events(self) -> tuple[dict[str, Any], ...]:
         with self._lock:
@@ -334,10 +358,12 @@ class MetricsLogger:
             self._append({"schema": SCHEMA_VERSION, **ev})
             if ev.get("event") == "degraded":
                 degraded += 1
+        mono, wall = _monotonic_wall()
         row: dict[str, Any] = {
             "schema": SCHEMA_VERSION,
             "step": int(step),
-            "time": round(time.time(), 3),
+            "time": round(wall, 3),
+            "mono": round(mono, 6),
         }
         if degraded:
             row["degraded"] = degraded
@@ -349,7 +375,7 @@ class MetricsLogger:
         if self._tb is not None:  # pragma: no cover - TB optional
             for key, val in row.items():
                 if isinstance(val, (int, float)) and key not in (
-                    "schema", "step", "time",
+                    "schema", "step", "time", "mono",
                 ):
                     self._tb.add_scalar(key, val, int(step))
         return row
@@ -421,7 +447,10 @@ def read_metrics(path: str) -> list[dict[str, Any]]:
 
 # Flight-dump schema.  v1: {"schema", "trigger": {"kind", "time", ...},
 # "context", "rows": [last-N metric rows, oldest first], "events"}.
-FLIGHT_SCHEMA_VERSION = 1
+# v2: + "spans" (last-N open/closed span rows from the active
+# utils/tracing.py tracer — the incident's local timeline) and "mono"
+# monotonic stamps alongside every "time" wall stamp.
+FLIGHT_SCHEMA_VERSION = 2
 
 
 class FlightRecorder:
@@ -459,6 +488,7 @@ class FlightRecorder:
         registry: Telemetry | None = None,
         context: dict[str, Any] | None = None,
         max_dumps_per_trigger: int = 5,
+        span_window: int = 32,
     ) -> None:
         if window < 1:
             raise ValueError(
@@ -466,6 +496,7 @@ class FlightRecorder:
             )
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
+        self.span_window = span_window
         self._rows: collections.deque = collections.deque(maxlen=window)
         self._events: collections.deque = collections.deque(maxlen=window)
         self._registry = registry if registry is not None else telemetry
@@ -486,7 +517,9 @@ class FlightRecorder:
 
     def record(self, step: int, **metrics: Any) -> None:
         """Append one metric row (host-coerced scalars) to the window."""
-        row = {"step": int(step), "time": round(time.time(), 3)}
+        mono, wall = _monotonic_wall()
+        row = {"step": int(step), "time": round(wall, 3),
+               "mono": round(mono, 6)}
         for key, val in metrics.items():
             row[key] = _to_scalar(val)
         with self._lock:
@@ -495,9 +528,11 @@ class FlightRecorder:
     def note_event(self, kind: str, **fields: Any) -> None:
         """Append a host-side event (checkpoint saved, lr change) to the
         window without going through the global registry."""
+        mono, wall = _monotonic_wall()
         with self._lock:
             self._events.append(
-                {"event": kind, "time": round(time.time(), 3), **fields}
+                {"event": kind, "time": round(wall, 3),
+                 "mono": round(mono, 6), **fields}
             )
 
     def observe_step(self, step: int, metrics: "TrainMetrics") -> str | None:
@@ -545,6 +580,9 @@ class FlightRecorder:
         original fault; the failure lands as an event row in the next
         dump) or this trigger kind already hit ``max_dumps_per_trigger``
         (``suppressed`` counts what was withheld)."""
+        mono, wall = _monotonic_wall()
+        tracer = _active_tracer()
+        spans = tracer.last_spans(self.span_window) if tracer else []
         with self._lock:
             count = self._per_trigger.get(trigger, 0)
             if self._max_per_trigger and count >= self._max_per_trigger:
@@ -552,7 +590,8 @@ class FlightRecorder:
                     self._events.append({
                         "event": "flight_dumps_capped", "trigger": trigger,
                         "limit": self._max_per_trigger,
-                        "time": round(time.time(), 3),
+                        "time": round(wall, 3),
+                        "mono": round(mono, 6),
                     })
                 self.suppressed[trigger] = self.suppressed.get(trigger, 0) + 1
                 return None
@@ -562,13 +601,19 @@ class FlightRecorder:
                 "schema": FLIGHT_SCHEMA_VERSION,
                 "trigger": {
                     "kind": trigger,
-                    "time": round(time.time(), 3),
+                    "time": round(wall, 3),
+                    "mono": round(mono, 6),
                     **{k: _to_scalar(v) for k, v in detail.items()},
                 },
                 "context": dict(self._context),
                 "rows": list(self._rows),
                 "events": list(self._events)
                 + list(self._registry.events()),
+                # the incident's local timeline: the last-N closed spans
+                # plus everything still open on the active tracer — what
+                # the process was DOING when the trigger fired, not just
+                # what its counters said
+                "spans": spans,
             }
             safe = "".join(
                 c if c.isalnum() or c in "-_" else "_" for c in trigger
@@ -643,15 +688,19 @@ class FlightRecorder:
 
 def read_flight_dump(path: str) -> dict[str, Any]:
     """Parse one flight dump, with a loud error naming an unknown schema
-    (forward-compat: readers must not silently misread a v2 dump)."""
+    (forward-compat: readers must not silently misread a v3 dump).
+    v1 dumps (no "spans"/"mono") stay readable — the additions were
+    backward compatible; the reader normalizes them with an empty
+    "spans" list."""
     with open(path) as f:
         payload = json.load(f)
     schema = payload.get("schema")
-    if schema != FLIGHT_SCHEMA_VERSION:
+    if schema not in (1, FLIGHT_SCHEMA_VERSION):
         raise ValueError(
             f"read_flight_dump: {path} has schema {schema!r}; this reader "
-            f"understands {FLIGHT_SCHEMA_VERSION}"
+            f"understands <= {FLIGHT_SCHEMA_VERSION}"
         )
+    payload.setdefault("spans", [])
     return payload
 
 

@@ -93,6 +93,7 @@ def main() -> None:
         FlightRecorder,
         make_train_step,
         resilience,
+        tracing,
     )
 
     cluster = chaos.cluster_from_env()
@@ -104,6 +105,10 @@ def main() -> None:
             process_id=pid,
         )
     proc = int(jax.process_index())
+    # span tracing (env-armed like chaos): every barrier wait, save
+    # phase, watchdog beat, and chaos kill from this process lands in
+    # its own spans_pNNNNN.jsonl for the merged cluster timeline
+    tracing.configure_from_env(process=proc)
     nproc = int(jax.process_count())
     world = int(jax.device_count())  # global across the cluster
 
@@ -218,8 +223,11 @@ def main() -> None:
                 injector.arm("hang_collective", float(
                     injector.value("wedge_seconds", 120) or 120
                 ))
-            params, opt_state, loss = step_fn(params, opt_state, tokens)
-            loss = float(loss)  # sync: the step is genuinely finished
+            with tracing.get_tracer().span("train/step", step=step):
+                params, opt_state, loss = step_fn(
+                    params, opt_state, tokens
+                )
+                loss = float(loss)  # sync: the step genuinely finished
             if dog is not None:
                 dog.beat(step)
             # mid-run hard death (kill_at_step=K): after the step
@@ -240,6 +248,7 @@ def main() -> None:
     mgr.close()
     if dog is not None:
         dog.stop()
+    tracing.shutdown()
     if log is not None:
         log.close()
     print(f"ELASTIC-OK start={start} world={world} proc={proc}",

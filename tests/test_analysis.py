@@ -681,6 +681,48 @@ def test_lint_ra013_remote_dma_outside_fused_kernel():
     ))
 
 
+def test_lint_ra014_raw_clock_outside_tracing_seam():
+    """RA014: a raw ``time.*`` clock read in the observability-
+    instrumented subpackages (elastic/, utils/) flags — emitted
+    timestamps must route through the ``utils/tracing.py`` seam so the
+    cluster-timeline merger's clock-offset correction covers them.  The
+    seam module itself, a reasoned allow, and out-of-scope packages are
+    clean."""
+    bad = (
+        "import time\n"
+        "def stamp():\n"
+        "    wall = time.time()\n"
+        "    mono = time.monotonic()\n"
+        "    return {'time': wall, 'mono': mono}\n"
+    )
+    violations = lint_source(bad, "ring_attention_tpu/elastic/toy.py")
+    assert [v.rule for v in violations] == ["RA014"] * 2
+    assert "utils/tracing.py" in violations[0].message
+    assert [v.rule for v in lint_source(
+        bad, "ring_attention_tpu/utils/toy.py"
+    )] == ["RA014"] * 2
+    # the seam module IS the allowed home of the raw reads
+    assert lint_source(bad, "ring_attention_tpu/utils/tracing.py") == []
+    # models/ etc. stay RA005's concern, not RA014's
+    assert [v.rule for v in lint_source(
+        bad, "ring_attention_tpu/models/toy.py"
+    )] == ["RA005"] * 2
+    allowed = bad.replace(
+        "time.monotonic()",
+        "time.monotonic()  # ra: allow(RA014 deadline arithmetic, "
+        "not an emitted timestamp)",
+    )
+    assert [v.rule for v in lint_source(
+        allowed, "ring_attention_tpu/elastic/toy.py"
+    )] == ["RA014"]
+    bare = bad.replace(
+        "time.monotonic()", "time.monotonic()  # ra: allow(RA014)"
+    )
+    assert any("reason is mandatory" in v.message for v in lint_source(
+        bare, "ring_attention_tpu/elastic/toy.py"
+    ))
+
+
 # ----------------------------------------------------------------------
 # Self-runs: the package itself is clean
 # ----------------------------------------------------------------------

@@ -26,6 +26,7 @@ TOOLS = sorted(glob.glob(os.path.join(REPO, "tools", "*.sh")))
 WATCHER = os.path.join(REPO, "tools", "tpu_window_watch.sh")
 KERNEL_VALIDATE = os.path.join(REPO, "tools", "tpu_kernel_validate.py")
 TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
+CLUSTER_TIMELINE = os.path.join(REPO, "tools", "cluster_timeline.py")
 CHECK_CONTRACTS = os.path.join(REPO, "tools", "check_contracts.py")
 PERF_GATE = os.path.join(REPO, "tools", "perf_gate.py")
 
@@ -154,6 +155,72 @@ def test_trace_report_diff_renders(tmp_path):
     assert "tokens_per_sec" in proc.stdout
     assert "-20.0%" in proc.stdout
     assert "pct" in proc.stdout
+
+
+def test_cluster_timeline_compiles():
+    py_compile.compile(CLUSTER_TIMELINE, doraise=True)
+
+
+def test_cluster_timeline_flags_parse():
+    """``cluster_timeline.py`` is stdlib-only and its flag surface
+    (``--chrome`` / ``--incident`` / ``--last``) must parse without any
+    jax import — the tracing analogue of the trace-report smoke."""
+    proc = subprocess.run(
+        [sys.executable, CLUSTER_TIMELINE, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    for flag in ("--chrome", "--incident", "--last", "--reference"):
+        assert flag in proc.stdout, f"{flag} missing from --help"
+
+
+def test_cluster_timeline_renders_and_incident_exit_codes(tmp_path):
+    """The three exits, each from a real span file: a table on a healthy
+    trace (0), exit 3 on ``--incident`` with no anchor, and the
+    annotated incident when a chaos kill is present (stdlib-only, no
+    jax import in the tool)."""
+    span = {"schema": 1, "trace": "t", "proc": 0, "kind": "span",
+            "name": "train/step", "span": 2, "parent": None,
+            "mono": 1.0, "wall": 100.0, "dur": 0.25,
+            "attrs": {"step": 0}}
+    trace = tmp_path / "trace"
+    trace.mkdir()
+    path = trace / "spans_p00000.jsonl"
+    path.write_text(json.dumps(span) + "\n")
+    proc = subprocess.run(
+        [sys.executable, CLUSTER_TIMELINE, str(trace)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "train/step" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, CLUSTER_TIMELINE, str(trace), "--incident"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 3, (proc.stdout, proc.stderr)
+    assert "no incident anchor" in proc.stderr
+
+    kill = {**span, "kind": "instant", "name": "chaos/kill", "span": 3,
+            "wall": 101.0, "attrs": {"fault": "kill_at_step"}}
+    del kill["dur"]
+    path.write_text(json.dumps(span) + "\n" + json.dumps(kill) + "\n")
+    proc = subprocess.run(
+        [sys.executable, CLUSTER_TIMELINE, str(trace), "--incident"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "chaos/kill on process 0" in proc.stdout
+
+    out = tmp_path / "chrome.json"
+    proc = subprocess.run(
+        [sys.executable, CLUSTER_TIMELINE, str(trace),
+         "--chrome", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(out.read_text())
+    assert {e["ph"] for e in payload["traceEvents"]} == {"M", "X", "i"}
 
 
 def test_perf_gate_compiles():

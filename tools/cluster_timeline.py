@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Merge per-process span files into one cluster timeline.
+
+Input is a trace directory written by ``utils/tracing.py`` — one
+``spans_pNNNNN.jsonl`` per process (``examples/train.py --trace-dir``,
+``examples/generate.py --trace-dir``, or ``RING_ATTN_TRACE_DIR`` on a
+chaos worker).  The merger stamps every row with its process, corrects
+each process's wall clock against the reference process using shared
+barrier-rendezvous rows (all processes leave the same named barrier at
+approximately the same true instant), and renders:
+
+- the default text table: one line per span/instant in corrected time
+  order, with process, duration, and attributes — the cluster's actual
+  interleaving, stragglers visible as long ``barrier/wait`` spans;
+- ``--chrome OUT.json``: Chrome trace-event JSON (open in Perfetto or
+  ``chrome://tracing``) with one track per process;
+- ``--incident``: the reconstruction around the last ``chaos/kill`` or
+  ``watchdog/abort`` anchor — names the victim process, the armed fault
+  window, the survivors' barrier waits (straggler watch), and the
+  timeline slice around the death.  Exit code 3 when no anchor exists
+  (the run died some other way, or didn't die).
+
+Stdlib-only: ``tracing.py`` is loaded by file path (no jax import), so
+this runs on a box where jax cannot.  Usage::
+
+  python tools/cluster_timeline.py /tmp/trace
+  python tools/cluster_timeline.py /tmp/trace --chrome /tmp/trace.json
+  python tools/cluster_timeline.py /tmp/trace --incident
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG_UTILS = os.path.join(
+    os.path.dirname(_HERE), "ring_attention_tpu", "utils"
+)
+
+
+def _load_tracing():
+    """Load ``utils/tracing.py`` by file path so this tool never imports
+    the package (whose ``__init__`` pulls in jax/flax) — the same
+    pattern as ``tools/trace_report.py``.  Memoized."""
+    name = "_timeline_tracing"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_PKG_UTILS, "tracing.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process span JSONL files into one "
+                    "clock-corrected cluster timeline "
+                    "(docs/observability.md §6)"
+    )
+    ap.add_argument("trace_dir",
+                    help="directory of spans_pNNNNN.jsonl files "
+                         "(utils/tracing.py)")
+    ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                    help="write Chrome trace-event JSON (Perfetto / "
+                         "chrome://tracing) instead of the text table")
+    ap.add_argument("--incident", action="store_true",
+                    help="reconstruct the last chaos/kill or "
+                         "watchdog/abort incident (exit 3 if none)")
+    ap.add_argument("--last", type=int, default=None, metavar="N",
+                    help="text table: only the last N rows")
+    ap.add_argument("--reference", type=int, default=None, metavar="P",
+                    help="clock-reference process (default: lowest "
+                         "process index)")
+    args = ap.parse_args(argv)
+
+    tracing = _load_tracing()
+    if not os.path.isdir(args.trace_dir):
+        print(f"cluster_timeline: no such directory: {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+    merged = tracing.merge_trace_dir(
+        args.trace_dir, reference=args.reference
+    )
+    if not merged["spans"]:
+        print(f"cluster_timeline: no span rows under {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+
+    if args.chrome:
+        payload = tracing.to_chrome_trace(merged)
+        with open(args.chrome, "w") as fh:
+            json.dump(payload, fh)
+        print(f"chrome trace: {args.chrome} "
+              f"({len(payload['traceEvents'])} events, "
+              f"{len(merged['processes'])} processes)")
+        return 0
+
+    if args.incident:
+        report = tracing.reconstruct_incident(merged)
+        if report is None:
+            print("cluster_timeline: no incident anchor (chaos/kill or "
+                  "watchdog/abort) in this trace", file=sys.stderr)
+            return 3
+        print(report)
+        return 0
+
+    print(tracing.render_timeline(merged, limit=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
