@@ -221,6 +221,27 @@ def _coverage_worker() -> None:
     print(json.dumps(coverage_fingerprint()))
 
 
+def _protocol_worker() -> None:
+    """Fused-ring DMA-protocol fingerprint (bench phase 0f): schedverify's
+    derived primitive counts, PROTOCOL row count, per-ring model event
+    counts, and total violations (0 on a healthy tree), from
+    ``analysis/schedverify.py::protocol_fingerprint`` — the verified hop
+    schedule as a pinned number, so any edit to the kernel's DMA/
+    semaphore protocol (or to its declared table) shows up in the perf
+    trajectory even on wedged-TPU rounds.  The extraction cross-check
+    traces the kernel on the simulated 8-device ring; env must precede
+    the first jax import, hence the subprocess."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from ring_attention_tpu.analysis.schedverify import protocol_fingerprint
+
+    print(json.dumps(protocol_fingerprint()))
+
+
 def _multihost_worker() -> None:
     """Multihost dryrun fingerprint (bench phase 0e): the hierarchical
     ``(dcn_data, data, ring[, ulysses])`` mesh's forward collective
@@ -1670,6 +1691,19 @@ def main() -> None:
     else:
         result["multihost_dryrun"] = {"error": (mh_err or "failed")[-200:]}
 
+    # phase 0f — fused-ring DMA-protocol fingerprint (CPU-only, pre-
+    # probe): schedverify's verified hop schedule as pinned numbers —
+    # derived DMA/semaphore counts, model event counts for rings 2..8,
+    # zero violations — gated exactly in analysis/perfgate.py
+    pr, pr_err = _run_attempt(
+        "cpu", 0, "protocol",
+        float(os.environ.get("BENCH_PROTO_BUDGET_S", 420)),
+    )
+    if pr is not None:
+        result["protocol_fingerprint"] = pr
+    else:
+        result["protocol_fingerprint"] = {"error": (pr_err or "failed")[-200:]}
+
     # phase 0c — train1m memory proof (CPU-only, pre-probe like the
     # fingerprint): chunked-vs-dense compiled peak temp bytes at equal
     # shape + the analytic 2^20-token peak-HBM estimate, so the
@@ -2059,6 +2093,8 @@ if __name__ == "__main__":
             _fingerprint_worker()
         elif mode == "multihost":
             _multihost_worker()
+        elif mode == "protocol":
+            _protocol_worker()
         elif mode == "coverage":
             _coverage_worker()
         elif mode == "window262k":

@@ -22,7 +22,14 @@ The passes, all CPU-runnable in tier-1 (see docs/static_analysis.md):
   - :mod:`~ring_attention_tpu.analysis.perfgate` — the perf-observatory
     regression gate: BENCH_r*.json / hwlog history ingest + CPU-signal
     checks against ``docs/perf_baseline.json`` (wedge-honest: rounds
-    whose TPU probe never ran are recorded, never silently passed).
+    whose TPU probe never ran are recorded, never silently passed);
+  - :mod:`~ring_attention_tpu.analysis.schedverify` — the DMA/semaphore
+    protocol verifier for the fused-ring kernel: jaxpr extraction of
+    every DMA/semaphore site cross-checked against the declared
+    ``PROTOCOL`` table, then a symbolic N-device model check (rings
+    2..8) for matched waits, overwrite-before-read races
+    (happens-before from semaphore edges), semaphore drain, and
+    deadlock freedom under arbitrary compute skew.
 
 CLI: ``tools/check_contracts.py`` (contract suite; ``--coverage`` /
 ``--dataflow`` for the prover and jaxpr audits), ``tools/perf_gate.py``
@@ -92,14 +99,16 @@ __all__ = [
     "lint_package",
     "lint_source",
     # imported lazily (contracts pulls in jax + the parallel stack;
-    # coverage pulls the kernel module for band_plan):
+    # coverage pulls the kernel module for band_plan; schedverify pulls
+    # the kernel module for its PROTOCOL table):
     "contracts",
     "coverage",
+    "schedverify",
 ]
 
 
 def __getattr__(name: str):
-    if name in ("contracts", "coverage"):
+    if name in ("contracts", "coverage", "schedverify"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

@@ -6,6 +6,9 @@ Lints the whole package tree, runs the f32 accumulator-dtype spot audit
 the int8 hop chain / the counter bwd pack, and the SPMD divergence
 checker over every strategy when multiple simulated devices are
 available), the tile-coverage prover (unless ``--no-coverage``), the
+fused-ring DMA/semaphore protocol verifier (unless ``--no-schedverify``:
+the rings-2..8 model check always, plus the jaxpr extraction
+cross-check when virtual devices are available), the
 elastic checkpoint contracts (unless ``--no-elastic``), and
 the perf-observatory gate (unless ``--no-gate``): benchmark-history
 trend checks plus the arithmetic comms-reference table and the coverage
@@ -67,6 +70,9 @@ def main(argv: list[str] | None = None) -> int:
                              "divergence passes")
     parser.add_argument("--no-coverage", action="store_true",
                         help="skip the tile-coverage prover")
+    parser.add_argument("--no-schedverify", action="store_true",
+                        help="skip the fused-ring DMA/semaphore protocol "
+                             "verifier (model check + jaxpr extraction)")
     parser.add_argument("--no-elastic", action="store_true",
                         help="skip the elastic checkpoint contracts "
                              "(manifest round-trip, resharded==direct "
@@ -104,6 +110,23 @@ def main(argv: list[str] | None = None) -> int:
 
         for report in coverage.run_coverage_suite():
             failures.extend(report.violations)
+    if not args.no_schedverify:
+        from . import schedverify
+
+        # the extraction cross-check traces on the full-device ring
+        if _have_virtual_devices(8):
+            for name, violations in schedverify.run_schedverify_suite():
+                failures.extend(violations)
+        else:
+            for name, violations in [(
+                "model", schedverify.verify_protocol())]:
+                failures.extend(violations)
+            notes.append(
+                "schedverify extraction skipped: backend already "
+                "initialized with < 8 devices (model check still ran; "
+                "tools/check_contracts.py --dma re-proves with virtual "
+                "devices)"
+            )
     if not args.no_elastic:
         # the elastic checks build 4-device sub-meshes
         if _have_virtual_devices(4):

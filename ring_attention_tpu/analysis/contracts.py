@@ -959,32 +959,31 @@ def check_scan_contract(strategy: str, mesh=None, *, directions=None,
 # remote DMAs, so the proof counts Mosaic DMA/semaphore primitives from the
 # traced kernel body instead of HLO collectives.  The counts are structural
 # (static ``pl.when`` branches, a once-traced ``fori_loop`` body), so they
-# are ring-size and shard-size independent.  Derivation against the kernel:
-#
-#   dma_start = 14:  2 seed (local KV -> slot 0)
-#                  + 2 remote push (one per static slot branch)
-#                  + 3 carry load (acc/m/l HBM -> VMEM)
-#                  + 4 kv staging (2 prologue + 2 in-loop prefetch)
-#                  + 3 carry store (acc/m/l VMEM -> HBM)
-#   dma_wait  = 14:  2 seed + 3 load + 2 kv staging + 3 store
-#                  + 4 remote (each remote wait drains send AND recv)
-#   semaphore_signal = 3:  2 seed barrier (left+right) + 1 grant to the
-#                          LEFT neighbor (the flow-control handshake)
-#   semaphore_wait   = 2:  1 seed barrier + 1 grant before the push
-#   get_barrier_semaphore = 1, and — the launch-free-hops claim itself —
-#   ZERO ppermutes anywhere in the forward.
+# are ring-size and shard-size independent.  They were hand-derived here
+# through PR 18 (dma_start 14, dma_wait 14, signal 3, wait 2, barrier 1);
+# since the protocol verifier landed they are DERIVED from the declared
+# schedule — the per-row ``sites`` fields of ops/pallas_ring.py::PROTOCOL,
+# summed by schedverify.derived_fused_counts() — so the contract pin and
+# the model-checked protocol cannot disagree silently.  The zero-ppermute
+# pin (the launch-free-hops claim itself) rides along in the derivation.
 FUSED_RING_PRIMS = (
     "dma_start", "dma_wait", "semaphore_signal", "semaphore_wait",
     "get_barrier_semaphore", "ppermute",
 )
-FUSED_RING_EXPECTED = {
-    "dma_start": 14,
-    "dma_wait": 14,
-    "semaphore_signal": 3,
-    "semaphore_wait": 2,
-    "get_barrier_semaphore": 1,
-    "ppermute": 0,
-}
+
+
+def _derived_fused_expected() -> dict[str, int]:
+    from .schedverify import derived_fused_counts
+
+    return derived_fused_counts()
+
+
+def __getattr__(name: str):
+    # FUSED_RING_EXPECTED stays importable (tests pin against it) but is
+    # computed from the verified PROTOCOL table, not hand-maintained.
+    if name == "FUSED_RING_EXPECTED":
+        return _derived_fused_expected()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def jaxpr_primitive_counts(closed_jaxpr, names) -> dict[str, int]:
@@ -1006,18 +1005,12 @@ def jaxpr_primitive_counts(closed_jaxpr, names) -> dict[str, int]:
     return dict(counts)
 
 
-def check_fused_ring_contract(
-    *, quantized: bool = False, b: int = 1, heads: int = 4,
-    kv_heads: int = 2, seq: int = 256, dim_head: int = 16,
-) -> ContractReport:
-    """The fused-ring contract row: trace the single-launch remote kernel
-    under ``shard_map`` on the full-device CPU ring and hold its traced
-    body to :data:`FUSED_RING_EXPECTED` — the expected in-kernel remote
-    copies and semaphore handshakes, and zero ``ppermute``s (the scan-path
-    ring's per-hop collective has no business in the fused forward).  The
-    ``quantized`` variant feeds PR 13's packed int8 payload through the
-    same kernel and must produce IDENTICAL counts: scales ride the KV
-    buffer, never their own copy."""
+def trace_fused_ring(*, quantized: bool = False, b: int = 1, heads: int = 4,
+                     kv_heads: int = 2, seq: int = 256, dim_head: int = 16):
+    """Trace the single-launch remote kernel under ``shard_map`` on the
+    full-device CPU ring — the shared feed for the fused contract row AND
+    schedverify's jaxpr extraction.  Returns ``(closed_jaxpr, dims)``;
+    make_jaxpr only, nothing compiles or runs."""
     import jax
     import jax.numpy as jnp
 
@@ -1062,6 +1055,27 @@ def check_fused_ring_contract(
         check_vma=False,
     )
     jaxpr = jax.make_jaxpr(fn)(mk(heads), mk(kv_heads), mk(kv_heads))
+    return jaxpr, dims
+
+
+def check_fused_ring_contract(
+    *, quantized: bool = False, b: int = 1, heads: int = 4,
+    kv_heads: int = 2, seq: int = 256, dim_head: int = 16,
+) -> ContractReport:
+    """The fused-ring contract row: trace the single-launch remote kernel
+    under ``shard_map`` on the full-device CPU ring and hold its traced
+    body to the schedverify-derived expected counts — the in-kernel remote
+    copies and semaphore handshakes declared by the verified PROTOCOL
+    table, and zero ``ppermute``s (the scan-path ring's per-hop collective
+    has no business in the fused forward).  The ``quantized`` variant
+    feeds PR 13's packed int8 payload through the same kernel and must
+    produce IDENTICAL counts: scales ride the KV buffer, never their own
+    copy."""
+    jaxpr, dims = trace_fused_ring(
+        quantized=quantized, b=b, heads=heads, kv_heads=kv_heads, seq=seq,
+        dim_head=dim_head,
+    )
+    mesh = default_mesh("ring")
     counted = jaxpr_primitive_counts(jaxpr, FUSED_RING_PRIMS)
 
     report = ContractReport(
@@ -1070,7 +1084,7 @@ def check_fused_ring_contract(
         mesh_shape=tuple(mesh.shape.values()), dims=dims,
         # zeros stay explicit: "ppermute": 0 IS the launch-free-hops pin
         counts={p: counted.get(p, 0) for p in FUSED_RING_PRIMS},
-        expected=dict(FUSED_RING_EXPECTED),
+        expected=_derived_fused_expected(),
     )
     for prim, want in report.expected.items():
         got = report.counts.get(prim, 0)

@@ -313,19 +313,29 @@ class JaxprWalker:
         and the kernel's refs is layout-dependent across jax versions, so
         the kernel jaxpr is re-seeded from its OWN ref avals (dtype-exact
         — precisely what a dtype/taint lattice needs) rather than mapped
-        positionally; outer outputs re-seed from their avals likewise."""
+        positionally; outer outputs re-seed from their avals likewise.
+        The seeding itself is a hook (:meth:`pallas_kernel_env`) so a
+        pass that needs ref IDENTITY rather than ref dtype — the
+        schedule extractor threads each kernel invar's POSITION through
+        cond branches and while carries to name the buffer/semaphore
+        behind every DMA equation — can override just the environment."""
         body = None
         for key in ("jaxpr", "kernel_jaxpr"):
             if key in eqn.params:
                 body = _as_jaxpr(eqn.params[key])
                 break
         if body is not None:
-            env = {
-                v: self.init_value(_inner_aval(v.aval))
-                for v in list(body.invars) + list(body.constvars)
-            }
+            env = self.pallas_kernel_env(body, eqn)
             self._walk(body, env, path + (f"pallas_call#{idx}",))
         return [self.init_value(v.aval) for v in eqn.outvars]
+
+    def pallas_kernel_env(self, body, eqn) -> dict:
+        """Initial environment for a pallas kernel body.  Default: every
+        ref invar/constvar starts at ``init_value`` of its inner aval."""
+        return {
+            v: self.init_value(_inner_aval(v.aval))
+            for v in list(body.invars) + list(body.constvars)
+        }
 
     def _walk_generic(self, eqn, in_vals, path, idx, site):
         """Default: apply the transfer function; conservatively descend

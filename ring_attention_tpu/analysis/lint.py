@@ -106,6 +106,22 @@ past PR, with the shim/convention that prevents it:
          Deadline arithmetic and filesystem-mtime comparisons carry a
          reasoned allow.
 
+  RA015  remote-DMA / semaphore primitive call site inside the fused
+         kernel module that no declared ``PROTOCOL`` row covers.  RA013
+         fences the primitives to ``ops/pallas_ring.py``; RA015 tightens
+         that file fence to a verified-seam fence: every
+         ``make_async_*copy`` / ``semaphore_*`` / ``get_barrier_semaphore``
+         call must live inside a function named by a ``PROTOCOL`` row's
+         ``fn`` field, because ``analysis/schedverify.py`` model-checks
+         exactly the declared rows (races, deadlock, semaphore drain) and
+         cross-checks them site-by-site against the traced kernel.  A
+         primitive issued from an undeclared function is protocol the
+         model never saw — the exact blind spot PR 18's review bugs hid
+         in.  Declare the row (and re-run the verifier) or carry a
+         reasoned allow.  The table must stay a literal assignment
+         (``PROTOCOL = (...)``): if it cannot be parsed from the AST,
+         every site is flagged.
+
 Silencing: append ``# ra: allow(RA00X reason...)`` to the flagged line
 (for RA007, the ``def`` line).  The reason is mandatory — a bare allow is
 itself a violation.  See docs/static_analysis.md.
@@ -192,6 +208,33 @@ REMOTE_DMA_CALLS = {
 }
 FUSED_KERNEL_MODULE = "ops/pallas_ring.py"
 
+# RA015: the declared-protocol seam inside the fused module — the literal
+# table whose rows name (via their "fn" field) the only functions allowed
+# to issue REMOTE_DMA_CALLS; analysis/schedverify.py model-checks exactly
+# those rows.
+PROTOCOL_TABLE_NAME = "PROTOCOL"
+
+
+def _protocol_fns(tree: ast.Module) -> frozenset[str]:
+    """Function names declared by the module's literal ``PROTOCOL`` table
+    (empty when the assignment is missing or not a pure literal — which
+    flags every primitive site, keeping the table honest)."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == PROTOCOL_TABLE_NAME
+                   for t in node.targets):
+            continue
+        try:
+            rows = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            return frozenset()
+        return frozenset(
+            row["fn"] for row in rows
+            if isinstance(row, dict) and isinstance(row.get("fn"), str)
+        )
+    return frozenset()
+
 # RA012: the one module allowed to spell the int8 full-scale constant in
 # arithmetic (every quant/dequant codec lives there).
 QUANT_SEAM_MODULE = "ops/quant.py"
@@ -261,6 +304,8 @@ class _Linter(ast.NodeVisitor):
         self.in_fused_seam = rel.replace("\\", "/").endswith(
             FUSED_KERNEL_MODULE
         )
+        self.fn_stack: list[str] = []  # enclosing FunctionDef names (RA015)
+        self.protocol_fns: frozenset[str] = frozenset()
         self.traced_pkg = any(
             rel.replace("\\", "/").startswith(f"ring_attention_tpu/{p}/")
             or f"/{p}/" in rel.replace("\\", "/")
@@ -364,6 +409,14 @@ class _Linter(ast.NodeVisitor):
                       "ops/pallas_ring.py — the fused ring owns the one "
                       "counted signal/wait protocol (contracts.py pins "
                       "it); a stray semaphore op can deadlock the ring")
+        elif (name in REMOTE_DMA_CALLS and self.in_fused_seam
+                and not any(f in self.protocol_fns for f in self.fn_stack)):
+            self.flag(node, "RA015",
+                      f"remote-DMA/semaphore primitive {name}() outside a "
+                      "declared PROTOCOL row — schedverify model-checks "
+                      "only the rows' fn seams (races/deadlock/drain); "
+                      "declare the row and re-run the verifier, or allow "
+                      "with a reason")
 
         if name in COLLECTIVE_CALLS and self.scope_depth == 0:
             self.flag(node, "RA004",
@@ -487,10 +540,20 @@ class _Linter(ast.NodeVisitor):
                       "bugs will surface deep in the kernels instead")
 
     def visit_Module(self, node: ast.Module) -> None:
+        if self.in_fused_seam:
+            self.protocol_fns = _protocol_fns(node)
         for child in node.body:
             if isinstance(child, ast.FunctionDef):
                 self._check_entry_point(child)
         self.generic_visit(node)
+
+    # -- RA015: enclosing-function tracking ----------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
 
 def lint_source(source: str, rel: str, path: str = "") -> list[Violation]:
@@ -529,7 +592,7 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="ring-attention-tpu repo-native lint (rules RA001-RA014)"
+        description="ring-attention-tpu repo-native lint (rules RA001-RA015)"
     )
     parser.add_argument("paths", nargs="*",
                         help="files to lint (default: the whole package)")

@@ -215,6 +215,17 @@ class BenchRound:
         return None
 
     @property
+    def protocol(self) -> dict[str, Any] | None:
+        """The fused-ring DMA-protocol fingerprint (bench phase 0f):
+        schedverify's derived primitive counts, PROTOCOL row count,
+        per-ring model event counts, and total violations
+        (``analysis/schedverify.py::protocol_fingerprint``)."""
+        fp = self.payload.get("protocol_fingerprint")
+        if isinstance(fp, dict) and "error" not in fp:
+            return {k: v for k, v in fp.items() if k != "gate_schema"}
+        return None
+
+    @property
     def multihost(self) -> dict[str, Any] | None:
         """The multihost-dryrun DCN fingerprint (bench phase 0e):
         per-row forward collective counts over the hierarchical
@@ -430,6 +441,7 @@ def collect_current(
     compiled: bool = True,
     coverage: bool = True,
     multihost: bool = True,
+    protocol: bool = True,
 ) -> dict[str, Any]:
     """The current build's CPU gate signals.
 
@@ -437,7 +449,9 @@ def collect_current(
     ``compiled=False`` skips the reference-step compile — the arithmetic
     comms table and the (numpy-only) tile-coverage fingerprint always
     land.  ``multihost=False`` skips the DCN dryrun fingerprint (it
-    needs >= 4 devices).  Each skipped family is simply absent, and
+    needs >= 4 devices); ``protocol=False`` skips the fused-ring
+    DMA-protocol fingerprint (its extraction cross-check traces on the
+    8-device ring).  Each skipped family is simply absent, and
     :func:`check` notes absent families instead of passing them
     silently.
     """
@@ -461,6 +475,10 @@ def collect_current(
         from .contracts import dcn_collective_fingerprint
 
         signals["multihost"] = dcn_collective_fingerprint()
+    if protocol and len(jax.devices()) >= 8:
+        from .schedverify import protocol_fingerprint
+
+        signals["protocol"] = protocol_fingerprint()
     if compiled:
         signals["compiled"] = compiled_reference_signals()
     return signals
@@ -504,7 +522,7 @@ def check_baseline(
 
     # exact families -----------------------------------------------------
     for family in ("fingerprint", "comms", "coverage", "multihost",
-                   "latency"):
+                   "protocol", "latency"):
         base = base_signals.get(family)
         cur = current.get(family)
         if base is None:
@@ -664,7 +682,8 @@ def check_history(
     # fingerprint drift between consecutive carrying rounds ---------------
     for family, getter in (("fingerprint", lambda r: r.fingerprint),
                            ("coverage", lambda r: r.coverage),
-                           ("multihost", lambda r: r.multihost)):
+                           ("multihost", lambda r: r.multihost),
+                           ("protocol", lambda r: r.protocol)):
         fps = [(r.number, getter(r)) for r in history.rounds
                if getter(r) is not None]
         for (n0, fp0), (n1, fp1) in zip(fps, fps[1:]):
@@ -695,7 +714,8 @@ def _downgrade_acknowledged_drift(
     """
     acknowledged = {
         s for s in baseline_report.checked
-        if s.startswith(("fingerprint.", "coverage.", "multihost."))
+        if s.startswith(("fingerprint.", "coverage.", "multihost.",
+                         "protocol."))
         and not any(f.series == s for f in baseline_report.findings)
     }
     kept: list[GateFinding] = []

@@ -77,6 +77,7 @@ COLLECTIVE_ID = 7
 
 __all__ = [
     "COLLECTIVE_ID",
+    "PROTOCOL",
     "fitted_blocks",
     "fused_ring_local",
     "fused_ring_remote",
@@ -393,6 +394,130 @@ def neighbor_mesh_coords(axis_name, ring_size: int):
             for a in names
         ]))
     return jnp.stack(rows).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# The declared DMA/semaphore protocol of the remote kernel
+# ---------------------------------------------------------------------------
+#
+# One row per copy / semaphore-handshake site group in
+# ``_fused_remote_kernel``, in kernel program order.  This table IS the
+# verified seam: ``analysis/schedverify.py`` extracts the actual
+# primitives from the traced kernel jaxpr, cross-checks them against
+# these rows (buffers, semaphores, remoteness, per-kind site counts),
+# and model-checks the composed N-device schedule built from the rows —
+# matched start/wait on both ends, no slot overwritten while a
+# concurrent reader holds it, semaphore drain, deadlock freedom.  The
+# fused contract's primitive counts (``contracts.check_fused_ring_
+# contract``) are DERIVED from the ``sites`` fields, and lint RA015
+# fences every remote-DMA/semaphore call site in this module to the
+# functions named in ``fn`` — edit the kernel's hop schedule and the
+# verifier, the contract, and the lint all move with this table or fail
+# loudly.
+#
+# Field semantics (all values literal — the table is read by ``ast``
+# from the lint, never imported there):
+#   row        stable id, named in diagnostics
+#   fn         innermost kernel function containing the primitive calls
+#   op         copy | remote_copy | remote_drain | barrier | sem_signal
+#              | sem_wait
+#   src / dst  buffer names (kernel scratch/input refs); *_slot is a
+#              python expression over ``hop``/``hops`` selecting the
+#              kvbuf ring-buffer slot (None = not the circulated buffer)
+#   sem / send_sem / recv_sem   semaphore scratch names ("barrier" is
+#              the collective-id barrier from get_barrier_semaphore)
+#   guard      hop-range predicate (expression over ``hop``/``hops``)
+#   tile       grid position within the hop: "first" ((bhi, qi) ==
+#              (0, 0)), "all" (every tile), "last" (the final tile) —
+#              fixes program order inside a hop
+#   to         remote target: None (local) | "left" | "right"
+#   addressing "mesh" = per-axis MESH coordinates over every bound mesh
+#              axis (neighbor_mesh_coords); the verifier proves this
+#              resolves inside the sender's replica group on multi-axis
+#              meshes, where a ring-rank LOGICAL id would not
+#   inc/value  semaphore increment / wait decrement
+#   sites      traced-jaxpr equation counts this row accounts for, by
+#              primitive — summed into the fused contract's expected
+#              counts
+PROTOCOL = (
+    # hop 0, first tile: local KV -> slot 0, then the seed barrier (no
+    # peer pushes into an unseeded neighbor's alternate slot).
+    {"row": "seed-k", "fn": "_seed", "op": "copy",
+     "src": "k_src", "src_slot": None, "dst": "kvbuf", "dst_slot": "0",
+     "sem": "load_sem", "guard": "hop == 0", "tile": "first", "to": None,
+     "sites": {"dma_start": 1, "dma_wait": 1}},
+    {"row": "seed-v", "fn": "_seed", "op": "copy",
+     "src": "v_src", "src_slot": None, "dst": "kvbuf", "dst_slot": "0",
+     "sem": "load_sem", "guard": "hop == 0", "tile": "first", "to": None,
+     "sites": {"dma_start": 1, "dma_wait": 1}},
+    {"row": "seed-barrier", "fn": "_seed", "op": "barrier",
+     "sem": "barrier", "signal_to": ("left", "right"), "inc": 1,
+     "value": 2, "addressing": "mesh", "guard": "hop == 0",
+     "tile": "first",
+     "sites": {"get_barrier_semaphore": 1, "semaphore_signal": 2,
+               "semaphore_wait": 1}},
+    # first tile, hop < hops-1: consume one receiver grant (the RIGHT
+    # neighbor finished reading the slot this push will overwrite), then
+    # start the async HBM->HBM push of the current slot into the right
+    # neighbor's alternate slot.  Two dma_start sites: the static
+    # cur == 0 / cur == 1 slot branches.
+    {"row": "push-grant", "fn": "_flow", "op": "sem_wait",
+     "sem": "grant_sem", "value": 1, "guard": "0 < hop < hops - 1",
+     "tile": "first", "sites": {"semaphore_wait": 1}},
+    {"row": "push-kv", "fn": "_copy", "op": "remote_copy",
+     "src": "kvbuf", "src_slot": "hop % 2",
+     "dst": "kvbuf", "dst_slot": "(hop + 1) % 2",
+     "send_sem": "send_sem", "recv_sem": "recv_sem",
+     "to": "right", "addressing": "mesh", "guard": "hop < hops - 1",
+     "tile": "first", "sites": {"dma_start": 2}},
+    # every tile: stage the (acc, m, l) carry in from its HBM spill,
+    # stream KV blocks of the CURRENT slot through VMEM, spill the
+    # carry back out.
+    {"row": "carry-load-acc", "fn": "_load_state", "op": "copy",
+     "src": "accb", "src_slot": None, "dst": "acc", "dst_slot": None,
+     "sem": "load_sem", "guard": "hop > 0", "tile": "all", "to": None,
+     "sites": {"dma_start": 1, "dma_wait": 1}},
+    {"row": "carry-load-m", "fn": "_load_state", "op": "copy",
+     "src": "mb", "src_slot": None, "dst": "m", "dst_slot": None,
+     "sem": "load_sem", "guard": "hop > 0", "tile": "all", "to": None,
+     "sites": {"dma_start": 1, "dma_wait": 1}},
+    {"row": "carry-load-l", "fn": "_load_state", "op": "copy",
+     "src": "lb", "src_slot": None, "dst": "l", "dst_slot": None,
+     "sem": "load_sem", "guard": "hop > 0", "tile": "all", "to": None,
+     "sites": {"dma_start": 1, "dma_wait": 1}},
+    # 4 starts (2 prologue + 2 in-loop prefetch, k and v parts), 2 waits
+    # (the loop body's per-part waits serve prologue and prefetch alike).
+    {"row": "kv-stage", "fn": "kv_copies", "op": "copy",
+     "src": "kvbuf", "src_slot": "hop % 2", "dst": "kvv",
+     "dst_slot": None, "sem": "kv_sems", "guard": "True", "tile": "all",
+     "to": None, "sites": {"dma_start": 4, "dma_wait": 2}},
+    {"row": "carry-store-acc", "fn": "_store_state", "op": "copy",
+     "src": "acc", "src_slot": None, "dst": "accb", "dst_slot": None,
+     "sem": "load_sem", "guard": "hop < hops - 1", "tile": "all",
+     "to": None, "sites": {"dma_start": 1, "dma_wait": 1}},
+    {"row": "carry-store-m", "fn": "_store_state", "op": "copy",
+     "src": "m", "src_slot": None, "dst": "mb", "dst_slot": None,
+     "sem": "load_sem", "guard": "hop < hops - 1", "tile": "all",
+     "to": None, "sites": {"dma_start": 1, "dma_wait": 1}},
+    {"row": "carry-store-l", "fn": "_store_state", "op": "copy",
+     "src": "l", "src_slot": None, "dst": "lb", "dst_slot": None,
+     "sem": "load_sem", "guard": "hop < hops - 1", "tile": "all",
+     "to": None, "sites": {"dma_start": 1, "dma_wait": 1}},
+    # last tile, hop < hops-1: drain the outbound send and the inbound
+    # landing (4 dma_wait sites: 2 static slot branches x send+recv),
+    # then grant the LEFT neighbor's next push — it targets exactly the
+    # slot this hop finished reading.  The last granted push is hop
+    # hops-2 consuming the grant from hop hops-3, so signals and waits
+    # balance and grant_sem drains to zero.
+    {"row": "hop-drain", "fn": "_wait", "op": "remote_drain",
+     "send_sem": "send_sem", "recv_sem": "recv_sem",
+     "guard": "hop < hops - 1", "tile": "last",
+     "sites": {"dma_wait": 4}},
+    {"row": "grant", "fn": "_grant", "op": "sem_signal",
+     "sem": "grant_sem", "inc": 1, "to": "left", "addressing": "mesh",
+     "guard": "hop < hops - 2", "tile": "last",
+     "sites": {"semaphore_signal": 1}},
+)
 
 
 def _fused_remote_kernel(his_ref, los_ref, works_ref, nbrs_ref, *refs,

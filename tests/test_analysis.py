@@ -662,8 +662,15 @@ def test_lint_ra013_remote_dma_outside_fused_kernel():
     violations = lint_source(bad, "ring_attention_tpu/parallel/newhop.py")
     assert [v.rule for v in violations] == ["RA013"] * 5
     assert "ops/pallas_ring.py" in violations[0].message
-    # the fused kernel module IS the seam
-    assert lint_source(bad, "ring_attention_tpu/ops/pallas_ring.py") == []
+    # the fused kernel module IS the seam — provided the function is a
+    # declared PROTOCOL row (RA015 fences the seam to the verified table)
+    declared = (
+        'PROTOCOL = (\n'
+        '    {"row": "hop", "fn": "hop", "op": "remote_copy",\n'
+        '     "sites": {"dma_start": 1}},\n'
+        ')\n' + bad
+    )
+    assert lint_source(declared, "ring_attention_tpu/ops/pallas_ring.py") == []
     allowed = bad.replace(
         "    pltpu.semaphore_wait(barrier, 1)\n",
         "    pltpu.semaphore_wait(barrier, 1)  "

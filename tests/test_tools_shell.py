@@ -283,7 +283,7 @@ def test_check_contracts_flags_parse():
     )
     assert proc.returncode == 0, proc.stderr
     for flag in ("--strategy", "--mesh", "--json", "--devices", "--memory",
-                 "--coverage", "--dataflow", "--elastic"):
+                 "--coverage", "--dataflow", "--dma", "--elastic"):
         assert flag in proc.stdout, f"{flag} missing from --help"
 
 
@@ -298,6 +298,20 @@ def test_check_contracts_coverage_exits_zero():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "coverage rows sound and tight" in proc.stdout
+
+
+def test_check_contracts_dma_exits_zero():
+    """Acceptance: ``check_contracts.py --dma`` re-proves the fused-ring
+    DMA/semaphore protocol — the rings-2..8 model check plus the jaxpr
+    extraction cross-check for the plain and q8 feeds — on CPU virtual
+    devices and exits 0."""
+    proc = subprocess.run(
+        [sys.executable, CHECK_CONTRACTS, "--dma"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "3/3 DMA-protocol checks hold" in proc.stdout
+    assert "protocol model (rings 2-8" in proc.stdout
 
 
 def test_check_contracts_elastic_exits_zero():

@@ -26,6 +26,14 @@ accumulator carry — both flash paths, the int8 hop chain, the counter
 bwd pack) and the SPMD divergence checker (branch-invariant collective
 sequences for every strategy, on simulated devices).
 
+``--dma`` runs the fused-ring DMA/semaphore protocol verifier
+(``analysis/schedverify.py``): the symbolic N-device model check over
+ring sizes 2..8 (matched waits on both ends, no slot overwritten while a
+concurrent reader holds it, semaphore drain, deadlock freedom under
+arbitrary compute skew) plus the jaxpr extraction cross-check of the
+traced kernel against the declared ``PROTOCOL`` table, for the plain and
+q8 feeds.
+
 ``--elastic`` runs the elastic checkpoint contracts
 (``elastic/verify.py``): manifest schema round-trip (mesh descriptor,
 per-leaf dtype/spec, shard digests matching disk), resharded-load ==
@@ -39,6 +47,7 @@ Examples:
   python tools/check_contracts.py --memory
   python tools/check_contracts.py --coverage
   python tools/check_contracts.py --dataflow
+  python tools/check_contracts.py --dma
   python tools/check_contracts.py --elastic
 
 Exit status 0 = every contract holds.  Runs anywhere (no TPU needed):
@@ -116,6 +125,13 @@ def main(argv: list[str] | None = None) -> int:
                         help="run the jaxpr dataflow passes (precision-"
                              "flow audit + SPMD divergence checker) "
                              "instead of the collective contracts")
+    parser.add_argument("--dma", action="store_true",
+                        help="run the fused-ring DMA/semaphore protocol "
+                             "verifier (rings-2..8 model check: matched "
+                             "waits, overwrite races, semaphore drain, "
+                             "deadlock freedom; plus the jaxpr extraction "
+                             "cross-check against the declared PROTOCOL "
+                             "table) instead of the collective contracts")
     parser.add_argument("--elastic", action="store_true",
                         help="run the elastic checkpoint contracts "
                              "(manifest schema round-trip, resharded-"
@@ -202,6 +218,31 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{len(reports) - len(failed)}/{len(reports)} coverage "
                   f"rows sound and tight")
         return 1 if failed else 0
+
+    if args.dma:
+        from ring_attention_tpu.analysis.schedverify import (
+            run_schedverify_suite,
+        )
+
+        checks = run_schedverify_suite()
+        failed_names = [name for name, v in checks if v]
+        if args.json:
+            print(json.dumps({
+                "ok": not failed_names,
+                "checked": len(checks),
+                "checks": [
+                    {"name": name, "ok": not v, "violations": v}
+                    for name, v in checks
+                ],
+            }, indent=2))
+        else:
+            for name, v in checks:
+                print(f"{'ok  ' if not v else 'FAIL'} {name}")
+                for line in v:
+                    print(f"     {line}")
+            print(f"{len(checks) - len(failed_names)}/{len(checks)} "
+                  f"DMA-protocol checks hold")
+        return 1 if failed_names else 0
 
     if args.elastic:
         from ring_attention_tpu.elastic.verify import run_elastic_suite
